@@ -1,0 +1,27 @@
+"""p2pdl_tpu — a TPU-native peer-to-peer decentralized learning framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference
+``yoontaeung/p2pdl`` project (peer-to-peer decentralized learning with local
+SGD, authenticated update exchange via Byzantine Reliable Broadcast, and
+FedAvg-style aggregation — see reference ``main.py``, ``node/node.py``).
+
+Architecture (TPU-first, not a port):
+
+- The *peer axis lives on the device mesh*: every peer's parameters are one
+  slice of a leading ``num_peers`` dimension of a single pytree, sharded over a
+  ``jax.sharding.Mesh`` axis and vmapped within each device for peers > devices.
+- Local SGD is a single ``jit``-compiled, ``lax.scan``-based step — no
+  per-batch host sync (the reference's per-batch ``.item()`` at
+  ``training/train.py:17`` is the anti-pattern this kills).
+- Every exchange pattern is an XLA collective over ICI: FedAvg = masked
+  ``psum``; robust aggregation (Krum / trimmed-mean / median) over
+  ``all_gather``-ed deltas; gossip = ``lax.ppermute`` rings; secure
+  aggregation = pairwise PRNG masks that cancel under ``psum``.
+- The trust plane (ECDSA signatures, Bracha-style reliable broadcast) stays
+  host-side, operating on digests of canonically-serialized updates, and never
+  serializes the device pipeline.
+"""
+
+__version__ = "0.1.0"
+
+from p2pdl_tpu.config import Config  # noqa: F401

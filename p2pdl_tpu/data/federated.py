@@ -1,0 +1,103 @@
+"""Peer-stacked federated datasets.
+
+Replaces the reference's ``load_data(num_clients, dataset_name, batch_size)``
+dispatcher + per-client DataLoaders (reference ``datasets/dataset.py:53-62``)
+with a single device-resident structure: inputs ``[peers, samples, ...]`` and
+labels ``[peers, samples]``, ready to shard along the peer mesh axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.data import partition as part
+from p2pdl_tpu.data import synthetic
+
+NUM_CLASSES = 10
+
+_IMAGE_SHAPES = {
+    "mnist": (28, 28, 1),
+    "cifar10": (32, 32, 3),
+    "synthetic": (28, 28, 1),
+}
+
+
+@dataclasses.dataclass
+class FederatedData:
+    """Device-resident federated dataset.
+
+    ``x``: ``[peers, samples, ...]`` inputs; ``y``: ``[peers, samples]``
+    targets. For sequence data ``x`` is ``[peers, samples, seq_len]`` int32
+    and ``y`` the next-character targets of the same shape. ``eval_x`` /
+    ``eval_y`` are a held-out global split (absent in the reference, which
+    evaluates on training shards — ``evaluation/evaluation.py:10``).
+    """
+
+    x: jnp.ndarray
+    y: jnp.ndarray
+    eval_x: jnp.ndarray
+    eval_y: jnp.ndarray
+    num_classes: int
+
+    @property
+    def num_peers(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def samples_per_peer(self) -> int:
+        return self.x.shape[1]
+
+
+def _label_proportions(cfg: Config, key: jax.Array, num_classes: int) -> jnp.ndarray:
+    if cfg.partition == "iid":
+        return part.iid_label_proportions(cfg.num_peers, num_classes)
+    return part.dirichlet_label_proportions(key, cfg.num_peers, num_classes, cfg.dirichlet_alpha)
+
+
+def make_federated_data(cfg: Config, key: jax.Array | None = None, eval_samples: int = 1024) -> FederatedData:
+    """Build the peer-stacked dataset named by ``cfg.dataset``.
+
+    Deterministic in ``cfg.seed`` (the reference pins its split with
+    ``torch.manual_seed(42)`` at ``datasets/dataset.py:30``; here the full
+    generation + partition is keyed).
+    """
+    if key is None:
+        key = jax.random.PRNGKey(cfg.seed)
+
+    if cfg.dataset == "shakespeare":
+        trans_key, text_key, eval_key = jax.random.split(key, 3)
+        # One shared transition matrix: train and eval must sample the same
+        # "language" or eval curves would never reflect learning.
+        trans = synthetic.markov_transition(trans_key)
+        seqs = synthetic.markov_text(
+            text_key, (cfg.num_peers, cfg.samples_per_peer), cfg.seq_len + 1, trans=trans
+        )
+        eval_seqs = synthetic.markov_text(
+            eval_key, (eval_samples,), cfg.seq_len + 1, trans=trans
+        )
+        return FederatedData(
+            x=seqs[..., :-1],
+            y=seqs[..., 1:],
+            eval_x=eval_seqs[..., :-1],
+            eval_y=eval_seqs[..., 1:],
+            num_classes=synthetic.SHAKESPEARE_VOCAB_SIZE,
+        )
+
+    shape = _IMAGE_SHAPES[cfg.dataset]
+    prop_key, label_key, proto_key, noise_key, ekey_l, ekey_x = jax.random.split(key, 6)
+    protos = synthetic.class_prototypes(proto_key, NUM_CLASSES, shape)
+    props = _label_proportions(cfg, prop_key, NUM_CLASSES)
+    y = part.sample_labels(label_key, props, cfg.samples_per_peer)
+    x = synthetic.class_conditional_images(noise_key, y, shape, NUM_CLASSES, prototypes=protos)
+
+    # Eval shares the class prototypes but uses fresh labels + noise, so eval
+    # accuracy measures generalization over noise, not memorization.
+    eval_y = jax.random.randint(ekey_l, (eval_samples,), 0, NUM_CLASSES)
+    eval_x = synthetic.class_conditional_images(
+        ekey_x, eval_y, shape, NUM_CLASSES, prototypes=protos
+    )
+    return FederatedData(x=x, y=y, eval_x=eval_x, eval_y=eval_y, num_classes=NUM_CLASSES)

@@ -1,0 +1,97 @@
+"""Deterministic synthetic datasets with learnable structure.
+
+Shape-compatible stand-ins for the reference's torchvision datasets
+(reference ``datasets/dataset.py:21-51``): MNIST-shaped ``(28, 28, 1)`` and
+CIFAR-shaped ``(32, 32, 3)`` class-conditional images, and a Markov-chain
+character stream standing in for Shakespeare. Fully deterministic under a
+JAX PRNG key; labels are a learnable function of inputs so accuracy curves
+are meaningful, not noise.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Size of the printable-ASCII-ish vocabulary used by the synthetic character
+# stream (matches the LEAF Shakespeare setup's scale of ~80 symbols).
+SHAKESPEARE_VOCAB_SIZE = 80
+
+
+def class_prototypes(
+    key: jax.Array, num_classes: int, shape: tuple[int, ...]
+) -> jnp.ndarray:
+    """Smooth per-class prototype images, deterministic in ``key``.
+
+    Prototypes are low-frequency random fields (random coarse grids upsampled
+    bilinearly) so classes differ in large-scale structure a conv net or MLP
+    can learn quickly.
+    """
+    h, w, c = shape
+    coarse = jax.random.normal(key, (num_classes, 4, 4, c))
+    protos = jax.image.resize(coarse, (num_classes, h, w, c), method="bilinear")
+    # Normalize each prototype to unit RMS so SNR is controlled by noise_scale.
+    rms = jnp.sqrt(jnp.mean(protos**2, axis=(1, 2, 3), keepdims=True) + 1e-8)
+    return protos / rms
+
+
+def class_conditional_images(
+    key: jax.Array,
+    labels: jnp.ndarray,
+    shape: tuple[int, ...],
+    num_classes: int = 10,
+    noise_scale: float = 1.0,
+    prototypes: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Images ``x = prototype[label] + noise`` for an arbitrary label array.
+
+    ``labels`` may have any leading shape (e.g. ``[peers, samples]``); the
+    output has shape ``labels.shape + shape``. Pass ``prototypes`` (from
+    :func:`class_prototypes`) to share class structure across splits — train
+    and eval must see the same prototypes with independent noise.
+    """
+    proto_key, noise_key = jax.random.split(key)
+    if prototypes is None:
+        prototypes = class_prototypes(proto_key, num_classes, shape)
+    x = prototypes[labels]
+    x = x + noise_scale * jax.random.normal(noise_key, x.shape)
+    return x.astype(jnp.float32)
+
+
+def markov_transition(key: jax.Array, vocab: int = SHAKESPEARE_VOCAB_SIZE) -> jnp.ndarray:
+    """A fixed, peaked character-transition matrix — the learnable "language"."""
+    logits = jax.random.normal(key, (vocab, vocab)) * 2.0
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def markov_text(
+    key: jax.Array,
+    batch_shape: tuple[int, ...],
+    seq_len: int,
+    vocab: int = SHAKESPEARE_VOCAB_SIZE,
+    trans: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Character sequences of shape ``batch_shape + (seq_len,)`` (int32).
+
+    Sampled from a first-order Markov chain, so next-character prediction has
+    real learnable structure (the transition matrix) with irreducible entropy
+    — loss curves behave like a real language-modeling task's. Pass ``trans``
+    (from :func:`markov_transition`) to share the chain across splits — train
+    and eval must sample the same "language"."""
+    trans_key, init_key, walk_key = jax.random.split(key, 3)
+    if trans is None:
+        trans = markov_transition(trans_key, vocab)
+    log_trans = jnp.log(trans + 1e-9)
+    n = 1
+    for d in batch_shape:
+        n *= d
+    state0 = jax.random.randint(init_key, (n,), 0, vocab)
+
+    def step(state, k):
+        nxt = jax.random.categorical(k, log_trans[state], axis=-1)
+        return nxt, nxt
+
+    keys = jax.random.split(walk_key, seq_len - 1)
+    _, rest = jax.lax.scan(step, state0, keys)
+    seq = jnp.concatenate([state0[None], rest], axis=0)  # [seq_len, n]
+    return jnp.moveaxis(seq, 0, -1).reshape(*batch_shape, seq_len).astype(jnp.int32)

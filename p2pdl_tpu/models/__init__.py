@@ -1,0 +1,66 @@
+"""Model zoo.
+
+The reference's complete model zoo is an MLP and a CIFAR-locked CNN
+(reference ``models/model.py:3-33``). Ours reproduces those two and extends to
+the benchmark families (ResNet-18, char-LSTM, ViT-Tiny). All models are
+``flax.linen`` modules: ``init`` yields a pure param pytree that stacks
+cleanly along a leading peer axis and shards over the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from p2pdl_tpu.models.mlp import MLP
+from p2pdl_tpu.models.cnn import SimpleCNN
+
+__all__ = ["MLP", "SimpleCNN", "get_model", "model_input_spec"]
+
+
+def get_model(name: str, **kwargs: Any):
+    """Build a model by config name (see ``config.MODELS``)."""
+    if name == "mlp":
+        return MLP(**kwargs)
+    if name == "simple_cnn":
+        return SimpleCNN(**kwargs)
+    if name == "resnet18":
+        from p2pdl_tpu.models.resnet import ResNet18
+
+        return ResNet18(**kwargs)
+    if name == "char_lstm":
+        from p2pdl_tpu.models.lstm import CharLSTM
+
+        return CharLSTM(**kwargs)
+    if name == "vit_tiny":
+        from p2pdl_tpu.models.vit import ViTTiny
+
+        return ViTTiny(**kwargs)
+    raise ValueError(f"unknown model {name!r}")
+
+
+def model_input_spec(model_name: str, dataset: str, seq_len: int = 128) -> tuple[tuple[int, ...], Any]:
+    """(example input shape without batch dim, dtype) for a model/dataset pair.
+
+    Image models take the dataset's native shape (MLP flattens internally, so
+    it serves both 28x28x1 and 32x32x3); sequence models take int tokens.
+    """
+    if model_name == "char_lstm":
+        return (seq_len,), jnp.int32
+    image_shape = (32, 32, 3) if dataset == "cifar10" else (28, 28, 1)
+    if model_name in ("mlp", "simple_cnn"):
+        return image_shape, jnp.float32
+    if model_name in ("resnet18", "vit_tiny"):
+        if dataset not in ("cifar10",):
+            # Conv stem / patch geometry is sized for 32x32x3.
+            raise ValueError(f"{model_name} requires dataset='cifar10', got {dataset!r}")
+        return (32, 32, 3), jnp.float32
+    raise ValueError(f"unknown model {model_name!r}")
+
+
+def init_params(model: Any, input_shape: tuple[int, ...], dtype: Any, key: jax.Array):
+    """Initialize one peer's params for ``model`` on a dummy batch of 1."""
+    dummy = jnp.zeros((1, *input_shape), dtype=dtype)
+    return model.init(key, dummy)["params"]

@@ -1,0 +1,29 @@
+"""Small conv net.
+
+Capability parity with reference ``models/model.py:17-33`` (two 3x3 conv +
+ReLU + 2x2 maxpool stages, 32 then 64 channels, then 512-unit head). Unlike
+the reference — whose flatten is hard-wired to 32x32x3 inputs and silently
+breaks on MNIST — this flattens whatever spatial extent it is given, so one
+module serves both MNIST and CIFAR-10.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SimpleCNN(nn.Module):
+    channels: tuple[int, int] = (32, 64)
+    hidden: int = 512
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for ch in self.channels:
+            x = nn.Conv(ch, kernel_size=(3, 3), padding="SAME")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,27 @@
+"""Character-level LSTM for the Shakespeare-style gossip benchmark config.
+
+Beyond the reference's model zoo; required by the BASELINE.json
+Shakespeare-LSTM config. Next-character prediction: ``[B, T]`` int tokens ->
+``[B, T, vocab]`` logits. The recurrence uses ``flax.linen.RNN`` (a
+``lax.scan`` under the hood) so the whole sequence unrolls inside one
+compiled loop with static shapes.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CharLSTM(nn.Module):
+    vocab_size: int = 80
+    embed_dim: int = 64
+    hidden: int = 256
+    num_layers: int = 2
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        h = nn.Embed(self.vocab_size, self.embed_dim)(x)
+        for _ in range(self.num_layers):
+            h = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(h)
+        return nn.Dense(self.vocab_size)(h)

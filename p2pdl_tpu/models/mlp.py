@@ -1,0 +1,26 @@
+"""MLP classifier.
+
+Capability parity with reference ``models/model.py:3-15`` (784 -> 512 -> 256
+-> 10, ReLU). Compute runs in a configurable dtype (bfloat16 by default via
+the train step) so the matmuls tile onto the MXU; params stay float32.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (512, 256)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.reshape((x.shape[0], -1))
+        for f in self.features:
+            x = nn.Dense(f)(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,57 @@
+"""ResNet-18 (CIFAR variant) for the 32-peer non-IID benchmark config.
+
+Beyond the reference's model zoo (reference ``models/model.py`` stops at a
+2-conv CNN); required by the BASELINE.json CIFAR-10/ResNet-18 config.
+
+Uses GroupNorm rather than BatchNorm: batch statistics do not aggregate
+meaningfully across federated peers (averaging running stats from disjoint
+non-IID shards is a known FedAvg failure mode), and GroupNorm keeps model
+state a pure params pytree — no mutable batch_stats collection to shard.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ResidualBlock(nn.Module):
+    features: int
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        residual = x
+        y = nn.Conv(self.features, (3, 3), self.strides, padding="SAME", use_bias=False)(x)
+        y = nn.GroupNorm(num_groups=min(32, self.features))(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=min(32, self.features))(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.features, (1, 1), self.strides, padding="SAME", use_bias=False
+            )(residual)
+            residual = nn.GroupNorm(num_groups=min(32, self.features))(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet18(nn.Module):
+    """CIFAR-style ResNet-18: 3x3 stem (no maxpool), stages (64,128,256,512)x2."""
+
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    features: Sequence[int] = (64, 128, 256, 512)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=32)(x)
+        x = nn.relu(x)
+        for stage, (blocks, feats) in enumerate(zip(self.stage_sizes, self.features)):
+            for block in range(blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = ResidualBlock(feats, strides)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,54 @@
+"""ViT-Tiny for the 1024-peer secure-aggregation benchmark config.
+
+Beyond the reference's model zoo; required by the BASELINE.json ViT-Tiny
+config. Standard ViT-Tiny geometry (dim 192, depth 12, 3 heads) with a 4x4
+patch stem sized for 32x32 inputs. Attention is factored through
+``p2pdl_tpu.ops.attention`` so the same blocks can run single-device or
+sequence-parallel (ring attention) over a mesh axis.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from p2pdl_tpu.ops.attention import MultiHeadAttention
+
+
+class TransformerBlock(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        y = nn.LayerNorm()(x)
+        x = x + MultiHeadAttention(self.dim, self.heads)(y)
+        y = nn.LayerNorm()(x)
+        y = nn.Dense(self.dim * self.mlp_ratio)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.dim)(y)
+        return x + y
+
+
+class ViTTiny(nn.Module):
+    patch: int = 4
+    dim: int = 192
+    depth: int = 12
+    heads: int = 3
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b = x.shape[0]
+        x = nn.Conv(self.dim, (self.patch, self.patch), strides=(self.patch, self.patch))(x)
+        x = x.reshape(b, -1, self.dim)  # [B, tokens, dim]
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.dim))
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.dim)), x], axis=1)
+        x = x + self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, x.shape[1], self.dim)
+        )
+        for _ in range(self.depth):
+            x = TransformerBlock(self.dim, self.heads)(x)
+        x = nn.LayerNorm()(x)
+        return nn.Dense(self.num_classes)(x[:, 0])

@@ -1,0 +1,21 @@
+"""On-device collective ops: aggregation reducers, gossip, secure masking."""
+
+from p2pdl_tpu.ops.aggregators import (
+    fedavg,
+    krum,
+    krum_scores,
+    median,
+    multi_krum,
+    pairwise_sq_dists,
+    trimmed_mean,
+)
+
+__all__ = [
+    "fedavg",
+    "krum",
+    "krum_scores",
+    "median",
+    "multi_krum",
+    "pairwise_sq_dists",
+    "trimmed_mean",
+]

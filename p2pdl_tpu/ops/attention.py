@@ -1,0 +1,61 @@
+"""Attention ops: single-device and (via ``ring_attention``) sequence-parallel.
+
+The reference has no attention or sequence models at all (its zoo is MLP+CNN,
+reference ``models/model.py``); this module exists for the transformer/LSTM
+benchmark families and for long-context scaling. The core scaled-dot-product
+is a pure function so the same module runs dense on one device or blockwise
+over a mesh axis with ``lax.ppermute`` (ring attention — see
+``p2pdl_tpu.ops.ring_attention``), using the online-softmax accumulator that
+makes blockwise attention exact.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = False) -> jnp.ndarray:
+    """Scaled dot-product attention. ``q,k,v``: [B, H, T, D]."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        t_q, t_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    weights = jnp.asarray(
+        nn.softmax(logits.astype(jnp.float32), axis=-1), dtype=q.dtype
+    )
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+class MultiHeadAttention(nn.Module):
+    """MHA over [B, T, dim].
+
+    With ``seq_axis`` set (the name of a mesh axis the sequence is sharded
+    over, inside ``shard_map``), attention runs as exact blockwise ring
+    attention (``p2pdl_tpu.ops.ring_attention``) — T here is the *local*
+    block and k/v blocks rotate over ICI. Otherwise dense single-device SDPA.
+    """
+
+    dim: int
+    heads: int
+    causal: bool = False
+    seq_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, t, _ = x.shape
+        head_dim = self.dim // self.heads
+        qkv = nn.Dense(3 * self.dim, use_bias=False)(x)
+        qkv = qkv.reshape(b, t, 3, self.heads, head_dim)
+        q, k, v = jnp.moveaxis(qkv, 2, 0)  # each [B, T, H, D]
+        q, k, v = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))  # [B, H, T, D]
+        if self.seq_axis is not None:
+            from p2pdl_tpu.ops.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, self.seq_axis, causal=self.causal)
+        else:
+            out = sdpa(q, k, v, causal=self.causal)
+        out = jnp.swapaxes(out, 1, 2).reshape(b, t, self.dim)
+        return nn.Dense(self.dim, use_bias=False)(out)

@@ -1,0 +1,84 @@
+"""Ring attention: exact sequence-parallel attention over a mesh axis.
+
+Long-context support the reference does not have (no attention or sequence
+dimension anywhere in reference ``models/model.py``), built the TPU way: the
+sequence is sharded over a mesh axis, each device keeps its query block
+resident, and key/value blocks rotate around the ring with one
+``lax.ppermute`` per step so communication rides ICI and overlaps with the
+block matmuls. The online-softmax (running max / normalizer) accumulation
+makes the blockwise result exactly equal to dense softmax attention
+(Liu et al., "Ring Attention with Blockwise Transformers", 2023; the
+numerics are the flash-attention recurrence).
+
+Memory per device is O(T_local^2-free): only the [B, H, Tq_local, Tk_local]
+block of logits is live at a time, so sequence length scales linearly with
+the number of devices.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    ``q, k, v``: local blocks ``[B, H, T_local, D]`` inside ``shard_map``;
+    the global sequence is the concatenation of blocks in mesh order.
+    Returns the local ``[B, H, T_local, D]`` output block, bitwise-equivalent
+    (up to float assoc.) to slicing dense attention over the full sequence.
+    """
+    n_dev = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    t_local = q.shape[2]
+    scale = q.shape[-1] ** -0.5
+    q32 = q.astype(jnp.float32) * scale
+
+    # Running flash-attention accumulators, tagged as varying over the mesh
+    # axis (pvary) so the scan carry types match the block-dependent updates.
+    o = lax.pvary(jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32), axis_name)
+    m = lax.pvary(jnp.full(q.shape[:3], -jnp.inf, jnp.float32), axis_name)
+    l = lax.pvary(jnp.zeros(q.shape[:3], jnp.float32), axis_name)
+
+    # Pass k/v to the next device each step; after s steps we hold the block
+    # originally owned by (my_idx - s) mod n_dev.
+    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+    q_pos = my_idx * t_local + jnp.arange(t_local)
+
+    def step(carry, s):
+        o, m, l, k_cur, v_cur = carry
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q32, k_cur.astype(jnp.float32))
+        if causal:
+            src = (my_idx - s) % n_dev
+            k_pos = src * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask, logits, -jnp.inf)
+        block_max = jnp.max(logits, axis=-1)
+        new_m = jnp.maximum(m, block_max)
+        # exp(-inf - -inf) guard: rows with no unmasked keys yet keep m=-inf.
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        p = jnp.exp(logits - safe_m[..., None])
+        if causal:
+            p = jnp.where(jnp.isfinite(logits), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32)
+        )
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, new_m, l_new, k_nxt, v_nxt), None
+
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o, m, l, k, v), jnp.arange(n_dev)
+    )
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)

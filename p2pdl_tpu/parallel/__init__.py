@@ -1,0 +1,23 @@
+"""SPMD peer-axis execution core.
+
+Where the reference runs N peers as N threads exchanging pickled TCP messages
+(reference ``node/node.py:81-112``, ``main.py:24-36``), this package puts the
+peer axis on the device mesh: peer state is a pytree with a leading
+``num_peers`` dimension sharded over a ``jax.sharding.Mesh`` axis, local
+training is a vmapped ``lax.scan`` under one ``jit``, and every exchange is
+an XLA collective over ICI.
+"""
+
+from p2pdl_tpu.parallel.mesh import make_mesh, peer_sharding, peers_per_device
+from p2pdl_tpu.parallel.peer_state import PeerState, init_peer_state
+from p2pdl_tpu.parallel.round import build_round_fn, build_eval_fn
+
+__all__ = [
+    "make_mesh",
+    "peer_sharding",
+    "peers_per_device",
+    "PeerState",
+    "init_peer_state",
+    "build_round_fn",
+    "build_eval_fn",
+]

@@ -1,0 +1,46 @@
+"""Device-mesh construction for the peer axis.
+
+The TPU-native replacement for the reference's fully-connected TCP mesh over
+127.0.0.1 (reference ``main.py:33-36``, ``node/node.py:251-263``): peers map
+onto a 1-D ``jax.sharding.Mesh`` axis named ``"peers"``; peers beyond the
+device count stack on an in-device vmap axis (two-level layout:
+``num_peers = n_devices * peers_per_device``).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+PEER_AXIS = "peers"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over ``n_devices`` (default: all) named ``"peers"``."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(f"requested {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (PEER_AXIS,))
+
+
+def peer_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for peer-stacked arrays: leading dim split over the peer axis."""
+    return NamedSharding(mesh, PartitionSpec(PEER_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def peers_per_device(num_peers: int, mesh: Mesh) -> int:
+    n_dev = mesh.devices.size
+    if num_peers % n_dev != 0:
+        raise ValueError(
+            f"num_peers ({num_peers}) must be divisible by mesh size ({n_dev}); "
+            f"round num_peers up to a multiple"
+        )
+    return num_peers // n_dev

@@ -1,0 +1,84 @@
+"""Peer-stacked training state.
+
+The reference's per-node state (model + SGD optimizer + loss constructed in
+``Node.__init__``, reference ``node/node.py:22-31``) becomes one pytree with
+a leading peer dimension, built under ``jit`` with per-peer PRNG keys.
+
+Deliberate deviation (documented, per SURVEY §7): the reference gives every
+node an *independent random init* and still averages deltas across them
+(reference ``main.py:25``, ``aggregator/aggregation.py:36-38``) — averaging
+deltas between unaligned parameter spaces. We synchronize the initial
+parameters across peers (standard FedAvg), keeping per-peer keys for data
+order and any peer-local stochasticity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax
+import jax
+import jax.numpy as jnp
+import optax
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.models import get_model, init_params, model_input_spec
+
+
+@flax.struct.dataclass
+class PeerState:
+    """All mutable experiment state; every array leaf leads with ``num_peers``
+    except ``round_idx``."""
+
+    params: Any  # pytree, leaves [P, ...]
+    opt_state: Any  # pytree, leaves [P, ...]
+    rng: jax.Array  # [P] peer PRNG keys (uint32 typed key array)
+    round_idx: jax.Array  # scalar int32, replicated
+
+
+def make_optimizer(cfg: Config) -> optax.GradientTransformation:
+    """Local-SGD optimizer (reference uses SGD lr=0.01, ``node/node.py:30``)."""
+    if cfg.momentum > 0.0:
+        return optax.sgd(cfg.lr, momentum=cfg.momentum)
+    return optax.sgd(cfg.lr)
+
+
+def build_model(cfg: Config):
+    kwargs: dict[str, Any] = {}
+    if cfg.model == "char_lstm":
+        from p2pdl_tpu.data.synthetic import SHAKESPEARE_VOCAB_SIZE
+
+        kwargs["vocab_size"] = SHAKESPEARE_VOCAB_SIZE
+    return get_model(cfg.model, **kwargs)
+
+
+def init_peer_state(cfg: Config, key: jax.Array | None = None) -> PeerState:
+    """Initialize synchronized params + per-peer keys (pure; jit-safe)."""
+    if key is None:
+        key = jax.random.PRNGKey(cfg.seed)
+    model = build_model(cfg)
+    input_shape, in_dtype = model_input_spec(cfg.model, cfg.dataset, cfg.seq_len)
+    init_key, peer_key = jax.random.split(key)
+    params = init_params(model, input_shape, in_dtype, init_key)
+    params = jax.tree.map(
+        lambda p: p.astype(cfg.param_dtype)
+        if jnp.issubdtype(p.dtype, jnp.floating)
+        else p,
+        params,
+    )
+    opt_state = make_optimizer(cfg).init(params)
+
+    def stack(leaf):
+        return jnp.broadcast_to(leaf[None], (cfg.num_peers, *leaf.shape))
+
+    return PeerState(
+        params=jax.tree.map(stack, params),
+        opt_state=jax.tree.map(stack, opt_state),
+        rng=jax.random.split(peer_key, cfg.num_peers),
+        round_idx=jnp.zeros((), jnp.int32),
+    )
+
+
+def params_bytes(params: Any) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))

@@ -1,0 +1,41 @@
+"""Test fixtures: simulate an 8-device TPU mesh on CPU.
+
+Must run before any ``jax`` import: forces the CPU backend with 8 virtual
+host devices so every sharding/collective path (shard_map, psum, all_gather,
+ppermute) is exercised without TPU hardware. This is the in-process
+multi-peer simulation idea from the reference (its 7-threads-on-loopback
+topology, SURVEY §4) done the XLA way.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The image's sitecustomize may import jax with JAX_PLATFORMS pinned to a TPU
+# backend before this conftest runs; backends initialize lazily, so overriding
+# the config here (before the first device query) still lands us on CPU.
+jax.config.update("jax_platforms", "cpu")
+
+from p2pdl_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    assert len(jax.devices()) == 8, "conftest did not get 8 virtual devices"
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="session")
+def mesh4():
+    return make_mesh(4)
+
+
+@pytest.fixture(scope="session")
+def mesh1():
+    return make_mesh(1)

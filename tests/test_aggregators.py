@@ -1,0 +1,104 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pdl_tpu.ops import aggregators as agg
+
+
+def _tree(arrs):
+    """Stack a list of per-update pytrees into one [T, ...] pytree."""
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *arrs)
+
+
+def _mk_updates(t=8, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"w": jnp.asarray(rng.normal(size=(d, d)), jnp.float32),
+         "b": jnp.asarray(rng.normal(size=(d,)), jnp.float32)}
+        for _ in range(t)
+    ]
+
+
+def test_fedavg_is_mean():
+    ups = _mk_updates(4)
+    out = agg.fedavg(_tree(ups))
+    expect = np.mean([np.asarray(u["w"]) for u in ups], axis=0)
+    np.testing.assert_allclose(np.asarray(out["w"]), expect, rtol=1e-6)
+
+
+def test_fedavg_weighted():
+    ups = _mk_updates(3)
+    w = jnp.asarray([1.0, 0.0, 0.0])
+    out = agg.fedavg(_tree(ups), weights=w)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(ups[0]["w"]), rtol=1e-6)
+
+
+def test_pairwise_dists_match_numpy():
+    ups = _mk_updates(5, d=8)
+    d = np.asarray(agg.pairwise_sq_dists(_tree(ups)))
+    flat = np.stack(
+        [np.concatenate([np.asarray(u["w"]).ravel(), np.asarray(u["b"]).ravel()]) for u in ups]
+    )
+    expect = ((flat[:, None] - flat[None, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(d, expect, rtol=1e-3, atol=1e-3)
+
+
+def test_krum_picks_central_update():
+    """7 clustered updates + 2 far outliers: Krum must pick from the cluster."""
+    rng = np.random.default_rng(1)
+    cluster = [
+        {"w": jnp.asarray(rng.normal(scale=0.1, size=(8,)), jnp.float32)} for _ in range(7)
+    ]
+    outliers = [{"w": jnp.asarray(rng.normal(loc=50.0, size=(8,)), jnp.float32)} for _ in range(2)]
+    out = agg.krum(_tree(cluster + outliers), f=2)
+    assert np.abs(np.asarray(out["w"])).max() < 1.0
+
+
+def test_multi_krum_excludes_outliers():
+    rng = np.random.default_rng(2)
+    cluster = [
+        {"w": jnp.asarray(rng.normal(scale=0.1, size=(8,)), jnp.float32)} for _ in range(7)
+    ]
+    outliers = [{"w": jnp.asarray(rng.normal(loc=50.0, size=(8,)), jnp.float32)} for _ in range(2)]
+    out = agg.multi_krum(_tree(cluster + outliers), f=2)
+    assert np.abs(np.asarray(out["w"])).max() < 1.0
+
+
+def test_trimmed_mean_removes_outliers():
+    vals = [{"w": jnp.full((4,), v)} for v in [0.0, 1.0, 2.0, 3.0, 1000.0, -1000.0]]
+    out = agg.trimmed_mean(_tree(vals), beta=0.2)  # trims 1 each side
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.5, rtol=1e-6)
+
+
+def test_trimmed_mean_rejects_overtrim():
+    vals = _tree([{"w": jnp.zeros((2,))} for _ in range(2)])
+    with pytest.raises(ValueError):
+        agg.trimmed_mean(vals, beta=0.5)
+
+
+def test_krum_rejects_insufficient_trainers():
+    ups = _tree(_mk_updates(4))
+    with pytest.raises(ValueError):
+        agg.krum(ups, f=1)  # needs T >= 2f+3 = 5
+
+
+def test_median_robust():
+    vals = [{"w": jnp.full((4,), v)} for v in [1.0, 2.0, 3.0, 1e6]]
+    out = agg.median(_tree(vals))
+    np.testing.assert_allclose(np.asarray(out["w"]), 2.5, rtol=1e-6)
+
+
+def test_all_reducers_preserve_tree_structure():
+    ups = _tree(_mk_updates(6))
+    for fn in [
+        lambda t: agg.fedavg(t),
+        lambda t: agg.krum(t, 1),
+        lambda t: agg.multi_krum(t, 1),
+        lambda t: agg.trimmed_mean(t, 0.2),
+        lambda t: agg.median(t),
+    ]:
+        out = fn(ups)
+        assert set(out.keys()) == {"w", "b"}
+        assert out["w"].shape == (16, 16)
+        assert out["b"].shape == (16,)

@@ -1,0 +1,48 @@
+import pytest
+
+from p2pdl_tpu.config import Config
+
+
+def test_defaults_match_reference_baseline():
+    """Defaults mirror the reference's hard-coded scenario
+    (reference ``main.py:12-14``, ``node/node.py:30``,
+    ``aggregator/aggregation.py:36``, ``datasets/dataset.py:53``)."""
+    cfg = Config()
+    assert cfg.rounds == 5
+    assert cfg.local_epochs == 5
+    assert cfg.lr == 0.01
+    assert cfg.server_lr == 0.1
+    assert cfg.batch_size == 32
+    assert cfg.model == "mlp"
+    assert cfg.dataset == "mnist"
+
+
+def test_json_roundtrip():
+    cfg = Config(num_peers=16, trainers_per_round=8, aggregator="krum", partition="dirichlet")
+    assert Config.from_json(cfg.to_json()) == cfg
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        {"num_peers": 1},
+        {"trainers_per_round": 0},
+        {"trainers_per_round": 99},
+        {"aggregator": "blockchain"},
+        {"model": "gpt5"},
+        {"dataset": "imagenet"},
+        {"partition": "sorted"},
+        {"trimmed_mean_beta": 0.5},
+        {"samples_per_peer": 8, "batch_size": 32},
+        {"byzantine_f": -1},
+    ],
+)
+def test_validation_rejects(kwargs):
+    with pytest.raises(ValueError):
+        Config(**kwargs)
+
+
+def test_derived_properties():
+    cfg = Config(num_peers=8, trainers_per_round=3, samples_per_peer=100, batch_size=32)
+    assert cfg.testers_per_round == 5
+    assert cfg.batches_per_epoch == 3

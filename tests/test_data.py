@@ -1,0 +1,94 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.data import make_federated_data
+from p2pdl_tpu.data.partition import dirichlet_label_proportions
+from p2pdl_tpu.data.synthetic import markov_text, markov_transition
+
+
+def test_shapes_mnist():
+    cfg = Config(num_peers=8, samples_per_peer=64)
+    d = make_federated_data(cfg, eval_samples=128)
+    assert d.x.shape == (8, 64, 28, 28, 1)
+    assert d.y.shape == (8, 64)
+    assert d.eval_x.shape == (128, 28, 28, 1)
+    assert d.num_classes == 10
+
+
+def test_shapes_cifar():
+    cfg = Config(dataset="cifar10", num_peers=4, samples_per_peer=32)
+    d = make_federated_data(cfg)
+    assert d.x.shape == (4, 32, 32, 32, 3)
+
+
+def test_shapes_shakespeare():
+    cfg = Config(
+        dataset="shakespeare", model="char_lstm", num_peers=4, samples_per_peer=32, seq_len=64
+    )
+    d = make_federated_data(cfg, eval_samples=16)
+    assert d.x.shape == (4, 32, 64)
+    assert d.y.shape == (4, 32, 64)
+    assert d.x.dtype == jnp.int32
+    # Next-char targets: y is x shifted by one.
+    np.testing.assert_array_equal(np.asarray(d.x)[..., 1:], np.asarray(d.y)[..., :-1])
+
+
+def test_deterministic_in_seed():
+    cfg = Config(num_peers=4, samples_per_peer=32)
+    d1 = make_federated_data(cfg)
+    d2 = make_federated_data(cfg)
+    np.testing.assert_array_equal(np.asarray(d1.x), np.asarray(d2.x))
+    d3 = make_federated_data(cfg.replace(seed=7))
+    assert not np.array_equal(np.asarray(d1.x), np.asarray(d3.x))
+
+
+def test_iid_vs_dirichlet_skew():
+    base = Config(num_peers=8, samples_per_peer=256)
+    iid = make_federated_data(base)
+    skew = make_federated_data(base.replace(partition="dirichlet", dirichlet_alpha=0.1))
+
+    def label_var(y):
+        counts = np.stack([np.bincount(np.asarray(p), minlength=10) for p in y])
+        return counts.std(axis=0).mean()
+
+    assert label_var(skew.y) > 2 * label_var(iid.y)
+
+
+def test_dirichlet_proportions_sum_to_one():
+    p = dirichlet_label_proportions(jax.random.PRNGKey(0), 16, 10, 0.5)
+    np.testing.assert_allclose(np.asarray(p.sum(-1)), 1.0, rtol=1e-5)
+
+
+def test_class_structure_is_learnable():
+    """Same-class samples must be closer than cross-class ones."""
+    cfg = Config(num_peers=2, trainers_per_round=2, samples_per_peer=128)
+    d = make_federated_data(cfg)
+    x = np.asarray(d.x[0]).reshape(128, -1)
+    y = np.asarray(d.y[0])
+    same, diff = [], []
+    for c in range(10):
+        mask = y == c
+        if mask.sum() < 2:
+            continue
+        mu = x[mask].mean(0)
+        same.append(np.linalg.norm(x[mask] - mu, axis=1).mean())
+        diff.append(np.linalg.norm(x[~mask] - mu, axis=1).mean())
+    assert np.mean(diff) > np.mean(same)
+
+
+def test_markov_text_has_structure():
+    """Bigram frequencies of generated text should correlate with the chain."""
+    key = jax.random.PRNGKey(3)
+    seqs = np.asarray(markov_text(key, (64,), 256, vocab=20))
+    trans = np.asarray(markov_transition(jax.random.split(key, 3)[0], 20))
+    counts = np.zeros((20, 20))
+    for s in seqs:
+        for a, b in zip(s[:-1], s[1:]):
+            counts[a, b] += 1
+    emp = counts / np.maximum(counts.sum(1, keepdims=True), 1)
+    rows = counts.sum(1) > 50
+    corr = np.corrcoef(emp[rows].ravel(), trans[rows].ravel())[0, 1]
+    assert corr > 0.8, f"markov structure not reproduced, corr={corr}"

@@ -1,0 +1,87 @@
+import jax
+import jax.numpy as jnp
+import pytest
+
+from p2pdl_tpu.models import get_model, init_params, model_input_spec
+
+
+@pytest.mark.parametrize(
+    "name,dataset",
+    [("mlp", "mnist"), ("simple_cnn", "mnist"), ("simple_cnn", "cifar10")],
+)
+def test_forward_shapes(name, dataset):
+    model = get_model(name)
+    shape, dtype = model_input_spec(name, dataset)
+    params = init_params(model, shape, dtype, jax.random.PRNGKey(0))
+    x = jnp.zeros((4, *shape), dtype)
+    out = model.apply({"params": params}, x)
+    assert out.shape == (4, 10)
+
+
+def test_mlp_matches_reference_architecture():
+    """Reference MLP is 784 -> 512 -> 256 -> 10 (``models/model.py:3-15``)."""
+    model = get_model("mlp")
+    params = init_params(model, (784,), jnp.float32, jax.random.PRNGKey(0))
+    dims = [params[k]["kernel"].shape for k in sorted(params)]
+    assert dims == [(784, 512), (512, 256), (256, 10)]
+
+
+def test_cnn_works_on_both_input_sizes():
+    """Unlike the reference's 32x32-locked flatten (``models/model.py:28``)."""
+    model = get_model("simple_cnn")
+    for shape in [(28, 28, 1), (32, 32, 3)]:
+        params = init_params(model, shape, jnp.float32, jax.random.PRNGKey(0))
+        out = model.apply({"params": params}, jnp.zeros((2, *shape)))
+        assert out.shape == (2, 10)
+
+
+def test_resnet18_forward():
+    model = get_model("resnet18")
+    params = init_params(model, (32, 32, 3), jnp.float32, jax.random.PRNGKey(0))
+    out = model.apply({"params": params}, jnp.zeros((2, 32, 32, 3)))
+    assert out.shape == (2, 10)
+
+
+def test_char_lstm_forward():
+    model = get_model("char_lstm", vocab_size=80)
+    params = init_params(model, (16,), jnp.int32, jax.random.PRNGKey(0))
+    out = model.apply({"params": params}, jnp.zeros((2, 16), jnp.int32))
+    assert out.shape == (2, 16, 80)
+
+
+def test_vit_tiny_forward():
+    model = get_model("vit_tiny", depth=2)
+    params = init_params(model, (32, 32, 3), jnp.float32, jax.random.PRNGKey(0))
+    out = model.apply({"params": params}, jnp.zeros((2, 32, 32, 3)))
+    assert out.shape == (2, 10)
+
+
+def test_mlp_adapts_to_cifar_shape():
+    """mlp+cifar10 is a valid config pair; Dense sizes from the 3072-dim input."""
+    shape, _ = model_input_spec("mlp", "cifar10")
+    assert shape == (32, 32, 3)
+    model = get_model("mlp")
+    params = init_params(model, shape, jnp.float32, jax.random.PRNGKey(0))
+    out = model.apply({"params": params}, jnp.zeros((2, *shape)))
+    assert out.shape == (2, 10)
+
+
+def test_incompatible_pairs_rejected():
+    from p2pdl_tpu.config import Config
+
+    with pytest.raises(ValueError):
+        Config(model="char_lstm", dataset="mnist")
+    with pytest.raises(ValueError):
+        Config(model="mlp", dataset="shakespeare")
+    with pytest.raises(ValueError):
+        Config(model="resnet18", dataset="mnist")
+    with pytest.raises(ValueError):
+        model_input_spec("vit_tiny", "mnist")
+
+
+def test_bf16_compute():
+    model = get_model("mlp")
+    params = init_params(model, (784,), jnp.float32, jax.random.PRNGKey(0))
+    bf16_params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+    out = model.apply({"params": bf16_params}, jnp.zeros((2, 784), jnp.bfloat16))
+    assert out.dtype == jnp.bfloat16

@@ -1,0 +1,54 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pdl_tpu.ops.secure_agg import apply_masks, pairwise_mask
+
+
+def _deltas(t, d=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(t, d)).astype(np.float32))
+
+
+def test_masks_cancel_in_sum():
+    t = 5
+    deltas = _deltas(t)
+    base = jax.random.PRNGKey(42)
+    trainer_ids = jnp.arange(t, dtype=jnp.int32)
+    masked = jax.vmap(
+        lambda d, pid: apply_masks({"w": d}, base, pid, trainer_ids, jnp.bool_(True))
+    )(deltas, trainer_ids)["w"]
+    np.testing.assert_allclose(
+        np.asarray(masked.sum(0)), np.asarray(deltas.sum(0)), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_individual_updates_are_hidden():
+    t = 4
+    deltas = _deltas(t)
+    base = jax.random.PRNGKey(0)
+    trainer_ids = jnp.arange(t, dtype=jnp.int32)
+    masked = jax.vmap(
+        lambda d, pid: apply_masks({"w": d}, base, pid, trainer_ids, jnp.bool_(True))
+    )(deltas, trainer_ids)["w"]
+    # Every individual masked update must differ substantially from its raw value.
+    diff = np.abs(np.asarray(masked) - np.asarray(deltas)).mean(axis=1)
+    assert (diff > 0.1).all(), f"masks too weak: {diff}"
+
+
+def test_pair_masks_are_symmetric():
+    """Both endpoints of a pair derive the same mask (opposite signs)."""
+    base = jax.random.PRNGKey(7)
+    tree = {"w": jnp.zeros((8,))}
+    ids = jnp.asarray([2, 5], jnp.int32)
+    m2 = pairwise_mask(base, jnp.int32(2), ids, tree)["w"]
+    m5 = pairwise_mask(base, jnp.int32(5), ids, tree)["w"]
+    np.testing.assert_allclose(np.asarray(m2), -np.asarray(m5), rtol=1e-6)
+
+
+def test_non_trainer_unmasked():
+    base = jax.random.PRNGKey(1)
+    d = {"w": jnp.ones((8,))}
+    ids = jnp.asarray([0, 1], jnp.int32)
+    out = apply_masks(d, base, jnp.int32(3), ids, jnp.bool_(False))
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.ones(8))
