@@ -1,0 +1,90 @@
+"""Benchmark: aggregation rounds/sec with 1024 simulated peers.
+
+The BASELINE.json metric ("aggregation rounds/sec at N={8,128,1024} peers";
+north star >= 50 rounds/sec at 1024 peers). The reference publishes no
+numbers (reference ``README.md`` has none; ``BASELINE.json`` records
+``"published": {}``), so ``vs_baseline`` is reported against the north-star
+target of 50 rounds/sec.
+
+One round = every peer runs a full local-SGD pass on its shard (1 epoch over
+32 samples, batch 32) + delta computation + masked-psum FedAvg + global
+sync — the complete data-plane work of the reference's
+train/exchange/aggregate/broadcast cycle (reference ``main.py:50-84``),
+executing as one compiled program.
+
+Prints exactly one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.data import make_federated_data
+from p2pdl_tpu.parallel import (
+    build_round_fn,
+    init_peer_state,
+    make_mesh,
+    peer_sharding,
+)
+
+NORTH_STAR_ROUNDS_PER_SEC = 50.0
+
+
+def bench_rounds_per_sec(num_peers: int = 1024, timed_rounds: int = 20) -> float:
+    cfg = Config(
+        num_peers=num_peers,
+        trainers_per_round=num_peers,
+        local_epochs=1,
+        samples_per_peer=32,
+        batch_size=32,
+        model="mlp",
+        dataset="mnist",
+    )
+    mesh = make_mesh()
+    data = make_federated_data(cfg, eval_samples=16)
+    state = init_peer_state(cfg)
+    sh = peer_sharding(mesh)
+    state = jax.tree.map(
+        lambda l: jax.device_put(l, sh) if getattr(l, "ndim", 0) >= 1 else l, state
+    )
+    x = jax.device_put(data.x, sh)
+    y = jax.device_put(data.y, sh)
+
+    round_fn = build_round_fn(cfg, mesh)
+    trainer_idx = jnp.arange(cfg.trainers_per_round, dtype=jnp.int32)
+    byz = jnp.zeros(cfg.num_peers)
+    key = jax.random.PRNGKey(0)
+
+    # Warmup / compile.
+    state, m = round_fn(state, x, y, trainer_idx, byz, key)
+    jax.block_until_ready(m["train_loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(timed_rounds):
+        state, m = round_fn(state, x, y, trainer_idx, byz, key)
+    jax.block_until_ready(m["train_loss"])
+    dt = time.perf_counter() - t0
+    return timed_rounds / dt
+
+
+def main() -> None:
+    value = bench_rounds_per_sec()
+    print(
+        json.dumps(
+            {
+                "metric": "agg_rounds_per_sec_1024peers_mlp",
+                "value": round(value, 3),
+                "unit": "rounds/sec",
+                "vs_baseline": round(value / NORTH_STAR_ROUNDS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
