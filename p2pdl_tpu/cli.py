@@ -1,0 +1,132 @@
+"""Command-line entry point.
+
+The reference lists a CLI as TODO (reference ``README.md:11``); its only
+entry is ``python main.py`` + curl. Here every config knob is a flag:
+
+    python -m p2pdl_tpu.cli --num-peers 8 --aggregator krum --rounds 5
+    python -m p2pdl_tpu.cli serve --port 5000      # HTTP orchestrator
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from p2pdl_tpu.config import AGGREGATORS, DATASETS, MODELS, PARTITIONS, Config
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="p2pdl_tpu", description="TPU-native peer-to-peer decentralized learning"
+    )
+    p.add_argument("mode", nargs="?", default="run", choices=["run", "serve", "bench"])
+    p.add_argument("--num-peers", type=int, default=8)
+    p.add_argument("--trainers-per-round", type=int, default=3)
+    p.add_argument("--byzantine-f", type=int, default=1)
+    p.add_argument("--rounds", type=int, default=5)
+    p.add_argument("--local-epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--samples-per-peer", type=int, default=512)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--momentum", type=float, default=0.0)
+    p.add_argument("--server-lr", type=float, default=0.1)
+    p.add_argument("--model", choices=MODELS, default="mlp")
+    p.add_argument("--dataset", choices=DATASETS, default="mnist")
+    p.add_argument("--partition", choices=PARTITIONS, default="iid")
+    p.add_argument("--dirichlet-alpha", type=float, default=0.5)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--aggregator", choices=AGGREGATORS, default="fedavg")
+    p.add_argument("--trimmed-mean-beta", type=float, default=0.1)
+    p.add_argument("--multi-krum-m", type=int, default=0)
+    p.add_argument("--brb", action="store_true", help="enable the BRB trust plane")
+    p.add_argument("--round-timeout-s", type=float, default=30.0)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--compute-dtype", default="bfloat16")
+    p.add_argument("--param-dtype", default="float32")
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--attack", default="none", help="Byzantine attack for injected peers")
+    p.add_argument("--byz-ids", default="", help="comma-separated adversarial peer ids")
+    p.add_argument("--log-path", default=None, help="JSONL metrics output")
+    p.add_argument("--port", type=int, default=5000, help="HTTP port (serve mode)")
+    p.add_argument("--n-devices", type=int, default=None, help="mesh size (default: all)")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    return Config(
+        num_peers=args.num_peers,
+        trainers_per_round=args.trainers_per_round,
+        byzantine_f=args.byzantine_f,
+        rounds=args.rounds,
+        local_epochs=args.local_epochs,
+        batch_size=args.batch_size,
+        samples_per_peer=args.samples_per_peer,
+        lr=args.lr,
+        momentum=args.momentum,
+        server_lr=args.server_lr,
+        model=args.model,
+        dataset=args.dataset,
+        partition=args.partition,
+        dirichlet_alpha=args.dirichlet_alpha,
+        seq_len=args.seq_len,
+        aggregator=args.aggregator,
+        trimmed_mean_beta=args.trimmed_mean_beta,
+        multi_krum_m=args.multi_krum_m,
+        brb_enabled=args.brb,
+        round_timeout_s=args.round_timeout_s,
+        seed=args.seed,
+        compute_dtype=args.compute_dtype,
+        param_dtype=args.param_dtype,
+        remat=args.remat,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    byz_ids = tuple(int(x) for x in args.byz_ids.split(",") if x.strip())
+
+    if args.mode == "serve":
+        from p2pdl_tpu.runtime.server import serve
+
+        server = serve(
+            cfg, port=args.port, attack=args.attack, byz_ids=byz_ids,
+            log_path=args.log_path, n_devices=args.n_devices,
+        )
+        print(json.dumps({"serving": True, "port": server.server_address[1]}))
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            server.shutdown()
+        return 0
+
+    if args.mode == "bench":
+        # bench.py lives at the repo root (driver contract), not inside the
+        # package — load it by path so the CLI works from any CWD.
+        import importlib.util
+        import os
+
+        bench_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py"
+        )
+        spec = importlib.util.spec_from_file_location("bench", bench_path)
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        bench.main()
+        return 0
+
+    from p2pdl_tpu.runtime.driver import Experiment
+
+    exp = Experiment(
+        cfg, attack=args.attack, byz_ids=byz_ids,
+        log_path=args.log_path, n_devices=args.n_devices,
+    )
+    for _ in range(cfg.rounds):
+        record = exp.run_round()
+        print(json.dumps(record.to_dict()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

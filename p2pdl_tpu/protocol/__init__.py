@@ -1,0 +1,30 @@
+"""Host-side trust plane: PKI, signatures, Byzantine Reliable Broadcast.
+
+The data plane (model math) runs on-device as XLA collectives; this package
+is the control/trust plane that the reference conflates with it (reference
+``node/node.py`` carries weights and protocol messages in the same pickled
+TCP stream, SURVEY §1). Signatures operate on SHA-256 digests of canonically
+serialized updates, so only 32 bytes ever cross the host boundary per
+authentication, and the device pipeline never blocks on crypto.
+"""
+
+from p2pdl_tpu.protocol.crypto import (
+    KeyServer,
+    digest_update,
+    generate_key_pair,
+    sign_data,
+    verify_signature,
+)
+from p2pdl_tpu.protocol.brb import BRBConfig, BRBInstance, BRBMessage, Broadcaster
+
+__all__ = [
+    "KeyServer",
+    "digest_update",
+    "generate_key_pair",
+    "sign_data",
+    "verify_signature",
+    "BRBConfig",
+    "BRBInstance",
+    "BRBMessage",
+    "Broadcaster",
+]

@@ -1,0 +1,244 @@
+"""Byzantine Reliable Broadcast (Bracha) with ECDSA-signed digests.
+
+Capability parity with the reference's echo/ready/sup protocol (reference
+``utils/broadcast.py:8-141``, handlers ``node/node.py:146-240``) — rebuilt as
+the *correct, parameterized* Bracha state machine the reference approximates:
+
+- The reference hard-codes every quorum to 4 (``node/node.py:165,209``),
+  contradicting its own ``(n-1)//3`` fault formula (``node/node.py:232``);
+  here the quorums derive from (n, f): echo quorum ``ceil((n+f+1)/2)``,
+  ready amplification ``f+1``, delivery ``2f+1`` — the standard thresholds
+  that tolerate f Byzantine peers for n > 3f.
+- The reference's tester increments its ready counter once per *signature in
+  one message* (``node/node.py:204`` — a single valid 'ready' yields cnt=4),
+  so one forged message can trigger delivery; here each counted vote is a
+  distinct signed message from a distinct peer.
+- Messages carry a 32-byte canonical digest (``crypto.digest_update``), not
+  the pickled update (reference signs and ships pickle,
+  ``utils/broadcast.py:19-30``); payload travels once in SEND, and the data
+  plane in simulation keeps it on-device entirely.
+
+The state machine is transport-agnostic and synchronous: ``handle(msg)``
+returns the messages to emit, the driver/transport decides how they travel
+(in-memory channels in simulation, framed TCP across hosts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import math
+from typing import Optional
+
+from p2pdl_tpu.protocol import crypto
+
+SEND, ECHO, READY = "send", "echo", "ready"
+
+
+@dataclasses.dataclass(frozen=True)
+class BRBConfig:
+    n: int  # total peers
+    f: int  # Byzantine fault budget
+
+    def __post_init__(self) -> None:
+        if self.n <= 3 * self.f:
+            raise ValueError(f"Bracha BRB requires n > 3f, got n={self.n}, f={self.f}")
+
+    @property
+    def echo_quorum(self) -> int:
+        return math.ceil((self.n + self.f + 1) / 2)
+
+    @property
+    def ready_amplify(self) -> int:
+        return self.f + 1
+
+    @property
+    def deliver_quorum(self) -> int:
+        return 2 * self.f + 1
+
+
+@dataclasses.dataclass(frozen=True)
+class BRBMessage:
+    kind: str  # send | echo | ready
+    sender: int  # originator of the broadcast
+    seq: int  # broadcast sequence number (e.g. round index)
+    from_id: int  # peer that emitted this message
+    digest: bytes
+    payload: Optional[bytes] = None  # only on SEND
+    signature: Optional[bytes] = None  # over signing_bytes(), except SEND payload sig
+
+    def signing_bytes(self) -> bytes:
+        return b"|".join(
+            [
+                self.kind.encode(),
+                str(self.sender).encode(),
+                str(self.seq).encode(),
+                self.digest,
+            ]
+        )
+
+
+class BRBInstance:
+    """One (sender, seq) broadcast as seen by one peer.
+
+    All votes are counted **per digest** (``dict[digest, set[from_id]]``):
+    with digest-blind counting, an equivocating sender plus f Byzantine
+    voters can assemble a mixed-digest READY quorum at a peer that never saw
+    the honest SEND and make it deliver a conflicting payload — per-digest
+    sets plus the sha256(payload) == quorum-digest delivery check exclude
+    that with up to f faults.
+    """
+
+    # Payload storage is keyed by digest; honest peers can only ever form a
+    # quorum for one digest, so a small cap bounds a spamming sender.
+    MAX_STORED_PAYLOADS = 4
+
+    def __init__(self, cfg: BRBConfig, my_id: int, key_server, private_key) -> None:
+        self.cfg = cfg
+        self.my_id = my_id
+        self.key_server = key_server
+        self.private_key = private_key
+        self.payloads: dict[bytes, bytes] = {}
+        self.accepted_digest: Optional[bytes] = None  # first valid SEND wins the echo
+        self.echoes: dict[bytes, set[int]] = {}
+        self.readies: dict[bytes, set[int]] = {}
+        # One counted vote per peer per kind: a Byzantine voter emitting many
+        # digests gets exactly one entry, bounding state at O(n) per instance.
+        self._echo_voted: set[int] = set()
+        self._ready_voted: set[int] = set()
+        self.sent_echo = False
+        self.sent_ready = False
+        self.delivered: Optional[bytes] = None
+
+    def _make(self, kind: str, sender: int, seq: int, digest: bytes, payload=None) -> BRBMessage:
+        msg = BRBMessage(kind, sender, seq, self.my_id, digest, payload)
+        return dataclasses.replace(
+            msg, signature=crypto.sign_data(self.private_key, msg.signing_bytes())
+        )
+
+    def broadcast(self, seq: int, payload: bytes) -> list[BRBMessage]:
+        """Originate: emit SEND to all (caller fans out)."""
+        digest = hashlib.sha256(payload).digest()
+        return [self._make(SEND, self.my_id, seq, digest, payload)]
+
+    def _try_deliver(self) -> None:
+        if self.delivered is not None:
+            return
+        for digest, voters in self.readies.items():
+            if len(voters) >= self.cfg.deliver_quorum and digest in self.payloads:
+                # Delivery strictly requires the payload matching the digest
+                # the quorum voted for (payloads dict only admits verified
+                # sha256 matches).
+                self.delivered = self.payloads[digest]
+                return
+
+    def handle(self, msg: BRBMessage) -> list[BRBMessage]:
+        """Advance the state machine; returns messages to fan out to all
+        peers. Check ``.delivered`` after each call."""
+        if not crypto_ok(self.key_server, msg):
+            return []
+        out: list[BRBMessage] = []
+
+        if msg.kind == SEND:
+            if msg.from_id != msg.sender or msg.payload is None:
+                return []
+            if hashlib.sha256(msg.payload).digest() != msg.digest:
+                return []
+            if msg.digest not in self.payloads and len(self.payloads) < self.MAX_STORED_PAYLOADS:
+                self.payloads[msg.digest] = msg.payload
+            # Echo at most once per (sender, seq), for the first valid SEND:
+            # an equivocating sender splits the honest echo vote and neither
+            # digest reaches the echo quorum.
+            if self.accepted_digest is None:
+                self.accepted_digest = msg.digest
+            if self.accepted_digest == msg.digest and not self.sent_echo:
+                self.sent_echo = True
+                out.append(self._make(ECHO, msg.sender, msg.seq, msg.digest))
+            # A late SEND can complete a delivery whose READY quorum for this
+            # digest already formed (payload was the missing piece).
+            self._try_deliver()
+
+        elif msg.kind == ECHO:
+            if msg.from_id in self._echo_voted:
+                return []
+            self._echo_voted.add(msg.from_id)
+            voters = self.echoes.setdefault(msg.digest, set())
+            voters.add(msg.from_id)
+            if len(voters) >= self.cfg.echo_quorum and not self.sent_ready:
+                self.sent_ready = True
+                out.append(self._make(READY, msg.sender, msg.seq, msg.digest))
+
+        elif msg.kind == READY:
+            if msg.from_id in self._ready_voted:
+                return []
+            self._ready_voted.add(msg.from_id)
+            voters = self.readies.setdefault(msg.digest, set())
+            voters.add(msg.from_id)
+            if len(voters) >= self.cfg.ready_amplify and not self.sent_ready:
+                self.sent_ready = True
+                out.append(self._make(READY, msg.sender, msg.seq, msg.digest))
+            self._try_deliver()
+
+        return out
+
+
+def crypto_ok(key_server, msg: BRBMessage) -> bool:
+    if msg.signature is None:
+        return False
+    return key_server.verify(msg.from_id, msg.signature, msg.signing_bytes())
+
+
+class Broadcaster:
+    """Per-peer BRB endpoint managing instances keyed by (sender, seq).
+
+    The reference spreads this state across ``Node`` fields
+    (``received_echo_cnt`` etc., ``node/node.py:46-52``) reset between
+    rounds by ``reset_delivered_flag`` (``node/node.py:55-66``); here each
+    broadcast is its own instance, so concurrent broadcasts cannot bleed
+    counters into each other.
+    """
+
+    def __init__(self, cfg: BRBConfig, my_id: int, key_server, private_key) -> None:
+        self.cfg = cfg
+        self.my_id = my_id
+        self.key_server = key_server
+        self.private_key = private_key
+        self.instances: dict[tuple[int, int], BRBInstance] = {}
+
+    def _instance(self, sender: int, seq: int) -> BRBInstance:
+        key = (sender, seq)
+        if key not in self.instances:
+            self.instances[key] = BRBInstance(
+                self.cfg, self.my_id, self.key_server, self.private_key
+            )
+        return self.instances[key]
+
+    def broadcast(self, seq: int, payload: bytes) -> list[BRBMessage]:
+        return self._instance(self.my_id, seq).broadcast(seq, payload)
+
+    def broadcast_equivocating(
+        self, seq: int, payload_a: bytes, payload_b: bytes
+    ) -> tuple[BRBMessage, BRBMessage]:
+        """Byzantine-sender behavior for fault injection: two validly-signed,
+        conflicting SENDs for the same (sender, seq). Correct BRB must never
+        let honest peers deliver different payloads — the split echo vote
+        means neither usually delivers at all."""
+        inst = self._instance(self.my_id, seq)
+        a = inst._make(SEND, self.my_id, seq, hashlib.sha256(payload_a).digest(), payload_a)
+        b = inst._make(SEND, self.my_id, seq, hashlib.sha256(payload_b).digest(), payload_b)
+        return a, b
+
+    def handle(self, msg: BRBMessage) -> list[BRBMessage]:
+        if msg.kind not in (SEND, ECHO, READY):
+            return []
+        return self._instance(msg.sender, msg.seq).handle(msg)
+
+    def delivered(self, sender: int, seq: int) -> Optional[bytes]:
+        inst = self.instances.get((sender, seq))
+        return inst.delivered if inst else None
+
+    def prune(self, before_seq: int) -> None:
+        """Evict instances of completed rounds (seq < before_seq) — without
+        this a long experiment leaks one instance per (sender, round)."""
+        for key in [k for k in self.instances if k[1] < before_seq]:
+            del self.instances[key]

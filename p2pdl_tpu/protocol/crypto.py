@@ -1,0 +1,131 @@
+"""PKI and signatures.
+
+Capability parity with reference ``utils/crypto.py``: per-peer ECDSA P-256 /
+SHA-256 keypairs (reference ``utils/crypto.py:42-48``), a ``KeyServer``
+registry standing in for a PKI (reference ``utils/crypto.py:7-40`` — an
+in-process trusted directory; ours is thread-safe and keyed by peer id), and
+sign/verify (reference ``utils/crypto.py:50-101``).
+
+Deliberate differences (documented): signatures cover a canonical SHA-256
+digest of the update pytree rather than pickled bytes (the reference signs
+``pickle.dumps`` output, ``utils/broadcast.py:19-21``, which is neither
+canonical nor safe to deserialize from the network), and there is no
+``verify_signature_2``-style ``return True`` stub (reference
+``utils/crypto.py:61-62``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+import numpy as np
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+
+
+def generate_key_pair():
+    """ECDSA keypair on SECP256R1 (reference ``utils/crypto.py:42-48``)."""
+    private_key = ec.generate_private_key(ec.SECP256R1())
+    return private_key, private_key.public_key()
+
+
+def sign_data(private_key, data: bytes) -> bytes:
+    """ECDSA/SHA-256 signature over ``data`` (reference ``utils/crypto.py:50-59``)."""
+    return private_key.sign(data, ec.ECDSA(hashes.SHA256()))
+
+
+def verify_signature(public_key, signature: bytes, data: bytes) -> bool:
+    """True iff ``signature`` is valid for ``data`` (reference
+    ``utils/crypto.py:64-101``, minus the KeyServer lookup — see
+    :meth:`KeyServer.verify`)."""
+    try:
+        public_key.verify(signature, data, ec.ECDSA(hashes.SHA256()))
+        return True
+    except InvalidSignature:
+        return False
+
+
+def digest_update(update) -> bytes:
+    """Canonical SHA-256 digest of an update pytree.
+
+    Hashes each leaf's path, shape, dtype, and raw little-endian bytes in
+    sorted-path order — a stable serialization, unlike pickle. This is the
+    only device->host transfer authentication requires (32-byte output).
+    """
+    import jax
+
+    h = hashlib.sha256()
+    leaves = jax.tree_util.tree_flatten_with_path(update)[0]
+    for path, leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.digest()
+
+
+def public_key_pem(public_key) -> bytes:
+    return public_key.public_bytes(
+        serialization.Encoding.PEM, serialization.PublicFormat.SubjectPublicKeyInfo
+    )
+
+
+def public_key_from_pem(pem: bytes):
+    return serialization.load_pem_public_key(pem)
+
+
+class KeyServer:
+    """Trusted public-key directory keyed by peer id.
+
+    The reference's ``KeyServer`` is an unlocked in-process dict keyed by
+    ``(addr, port)`` (reference ``utils/crypto.py:7-40``) mutated from
+    concurrent threads; this one is thread-safe, stores PEM (so it works
+    across process boundaries), and refuses re-registration with a different
+    key (key-substitution guard).
+    """
+
+    def __init__(self) -> None:
+        self._keys: dict[int, bytes] = {}
+        # Deserialized-key cache: verify() runs per BRB message (O(n^2) per
+        # round) and must not re-parse PEM every time.
+        self._cache: dict[int, object] = {}
+        self._lock = threading.Lock()
+
+    def register_key(self, peer_id: int, public_key) -> None:
+        pem = public_key_pem(public_key)
+        with self._lock:
+            existing = self._keys.get(peer_id)
+            if existing is not None and existing != pem:
+                raise ValueError(f"peer {peer_id} already registered with a different key")
+            self._keys[peer_id] = pem
+            self._cache[peer_id] = public_key
+
+    def get_key(self, peer_id: int):
+        with self._lock:
+            key = self._cache.get(peer_id)
+            if key is not None:
+                return key
+            pem = self._keys.get(peer_id)
+        if pem is None:
+            raise KeyError(f"no key registered for peer {peer_id}")
+        key = public_key_from_pem(pem)
+        with self._lock:
+            self._cache[peer_id] = key
+        return key
+
+    def verify(self, peer_id: int, signature: bytes, data: bytes) -> bool:
+        """Verify ``data`` against peer ``peer_id``'s registered key
+        (reference ``utils/crypto.py:64-101`` folds this lookup into
+        ``verify_signature``)."""
+        try:
+            key = self.get_key(peer_id)
+        except KeyError:
+            return False
+        return verify_signature(key, signature, data)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._keys)

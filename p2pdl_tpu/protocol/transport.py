@@ -1,0 +1,213 @@
+"""Control-plane transports: deterministic in-memory hub and framed TCP.
+
+The reference's transport is inlined raw-socket code (reference
+``node/node.py:81-112, 257-263, 289-297``): one fresh TCP connection per
+message, 4-byte big-endian length prefix + **pickle** payload — with two
+landmines this module deliberately fixes:
+
+- ``connect()`` sends its pickle *without* the length prefix
+  (``node/node.py:259``) while the receive path always reads one
+  (``node/node.py:99-102``), so every handshake is silently dropped
+  (SURVEY §2 #9). Here a single ``send_frame``/``recv_frame`` pair is the
+  only wire codec, used by every path.
+- pickle deserialization of network input is arbitrary code execution;
+  messages here are JSON with base64-encoded byte fields.
+
+Simulation uses ``InMemoryHub``: a synchronous FIFO message pump with
+injectable drop/corrupt/delay faults — the deterministic test harness the
+reference lacks (SURVEY §5 "failure detection": its only timeout mechanism
+is inoperative, ``utils/waiting.py``).
+"""
+
+from __future__ import annotations
+
+import base64
+import collections
+import json
+import socket
+import struct
+import threading
+from typing import Callable, Optional
+
+from p2pdl_tpu.protocol.brb import BRBMessage
+
+Handler = Callable[[int, bytes], None]  # (src_id, data) -> None
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 1 << 30
+
+
+def send_frame(sock: socket.socket, data: bytes) -> None:
+    """Length-prefixed send (reference framing, ``node/node.py:289-296``)."""
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_frame(sock: socket.socket) -> Optional[bytes]:
+    """Read one length-prefixed frame; None on EOF/oversize."""
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        return None
+    return _recv_exact(sock, length)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(65536, n - len(buf)))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def brb_to_wire(msg: BRBMessage) -> bytes:
+    def b64(x):
+        return base64.b64encode(x).decode() if x is not None else None
+
+    return json.dumps(
+        {
+            "kind": msg.kind,
+            "sender": msg.sender,
+            "seq": msg.seq,
+            "from_id": msg.from_id,
+            "digest": b64(msg.digest),
+            "payload": b64(msg.payload),
+            "signature": b64(msg.signature),
+        }
+    ).encode()
+
+
+def brb_from_wire(data: bytes) -> Optional[BRBMessage]:
+    """Parse a wire message; None (not an exception) on malformed input —
+    garbage from the network must not take down the node."""
+    try:
+        d = json.loads(data)
+
+        def unb64(x):
+            return base64.b64decode(x) if x is not None else None
+
+        return BRBMessage(
+            kind=str(d["kind"]),
+            sender=int(d["sender"]),
+            seq=int(d["seq"]),
+            from_id=int(d["from_id"]),
+            digest=unb64(d["digest"]),
+            payload=unb64(d.get("payload")),
+            signature=unb64(d.get("signature")),
+        )
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
+class InMemoryHub:
+    """Deterministic synchronous message router with fault injection.
+
+    ``drop(src, dst, data) -> bool`` and ``corrupt(src, dst, data) -> bytes``
+    hooks inject network faults; ``pump()`` delivers queued messages FIFO
+    until quiescence, so protocol cascades (echo storms) run to completion
+    deterministically — no threads, no races.
+    """
+
+    def __init__(
+        self,
+        drop: Optional[Callable[[int, int, bytes], bool]] = None,
+        corrupt: Optional[Callable[[int, int, bytes], bytes]] = None,
+    ) -> None:
+        self._handlers: dict[int, Handler] = {}
+        self._queue: collections.deque[tuple[int, int, bytes]] = collections.deque()
+        self.drop = drop
+        self.corrupt = corrupt
+        self.messages_sent = 0
+        self.bytes_sent = 0
+
+    def register(self, peer_id: int, handler: Handler) -> None:
+        self._handlers[peer_id] = handler
+
+    def send(self, src: int, dst: int, data: bytes) -> None:
+        self.messages_sent += 1
+        self.bytes_sent += len(data)
+        if self.drop is not None and self.drop(src, dst, data):
+            return
+        if self.corrupt is not None:
+            data = self.corrupt(src, dst, data)
+        self._queue.append((src, dst, data))
+
+    def pump(self, max_messages: int = 1_000_000) -> int:
+        """Deliver until quiescent; returns number delivered."""
+        delivered = 0
+        while self._queue and delivered < max_messages:
+            src, dst, data = self._queue.popleft()
+            handler = self._handlers.get(dst)
+            if handler is not None:
+                handler(src, data)
+            delivered += 1
+        return delivered
+
+
+class TCPTransport:
+    """Framed-TCP transport: one listener thread, fresh connection per send
+    (the reference's connection discipline, ``aggregator/aggregation.py:72-77``,
+    kept deliberately — control messages are small and rare; the data plane
+    never touches TCP)."""
+
+    def __init__(self, my_id: int, host: str, port: int, handler: Handler) -> None:
+        self.my_id = my_id
+        self.host = host
+        self.port = port
+        self.handler = handler
+        self.peers: dict[int, tuple[str, int]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._sock: Optional[socket.socket] = None
+
+    def add_peer(self, peer_id: int, host: str, port: int) -> None:
+        self.peers[peer_id] = (host, port)
+
+    def start(self) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self.port = self._sock.getsockname()[1]  # resolve port 0
+        self._sock.listen(64)
+        self._sock.settimeout(0.2)
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        with conn:
+            frame = recv_frame(conn)
+            if frame is None or len(frame) < _LEN.size:
+                return
+            (src,) = _LEN.unpack(frame[: _LEN.size])
+            self.handler(src, frame[_LEN.size :])
+
+    def send(self, dst: int, data: bytes) -> bool:
+        addr = self.peers.get(dst)
+        if addr is None:
+            return False
+        try:
+            with socket.create_connection(addr, timeout=5.0) as s:
+                send_frame(s, _LEN.pack(self.my_id) + data)
+            return True
+        except OSError:
+            return False
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            self._sock.close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

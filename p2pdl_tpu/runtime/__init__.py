@@ -1,0 +1,12 @@
+"""Host-side runtime: round driver, node API, HTTP facade.
+
+The thin control plane above the compiled data plane — the role the
+reference's Flask app + ``Node`` threads play (reference ``main.py``,
+``node/node.py``), minus the shared-mutable-state races (single-threaded
+driver, message-passing protocol layer).
+"""
+
+from p2pdl_tpu.runtime.driver import Experiment, RoundRecord, run_experiment
+from p2pdl_tpu.runtime.cluster import Cluster, Node
+
+__all__ = ["Experiment", "RoundRecord", "run_experiment", "Cluster", "Node"]

@@ -1,0 +1,154 @@
+"""Node-level API parity over the SPMD engine.
+
+A user of the reference drives ``Node`` objects: construct, ``start()``,
+``connect()`` them into a mesh, ``set_start_learning()`` on trainers, wait
+for delivery, ``testing()`` on testers (reference ``node/node.py:21-326``,
+orchestrated by ``main.py:22-87``). This module offers the same surface:
+``Cluster`` owns the compiled experiment (the peers all live on the device
+mesh), and each ``Node`` is a per-peer handle exposing the reference's
+methods with the same semantics — minus its races and silent failure modes.
+
+Key behavioral mapping:
+- ``set_start_learning(rounds, epochs)`` marks the node a trainer for the
+  pending round (reference ``node/node.py:322-326`` trains + fans out
+  updates); the round executes collectively once every sampled trainer has
+  called it (the reference's thread-join barrier, ``main.py:79-80``).
+- ``wait_for_delivered()`` blocks until this peer's BRB instances for the
+  round delivered (reference ``node/node.py:71-74``) — but with the
+  config's round timeout, not forever.
+- ``testing()`` aggregates + evaluates (reference ``node/node.py:315-317``)
+  and returns ``{"accuracy", "addr", "port"}`` like reference
+  ``evaluation/evaluation.py:20-24`` — except accuracy is held-out, and
+  aggregation already happened deterministically on-device.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.runtime.driver import Experiment, RoundRecord
+
+
+class Node:
+    def __init__(self, cluster: "Cluster", node_id: int, addr: str, port: int) -> None:
+        self.cluster = cluster
+        self.node_id = node_id
+        self.addr = addr
+        self.port = port
+        self.running = False
+        self.neighbors: list["Node"] = []
+        self._delivered = threading.Event()
+
+    # -- lifecycle (reference node/node.py:76-95) --
+    def start(self) -> None:
+        self.running = True
+
+    def stop(self) -> None:
+        self.running = False
+
+    def connect(self, other: "Node") -> None:
+        """Record a neighbor (reference ``node/node.py:251-263``; its TCP
+        handshake is silently dropped remotely — SURVEY §2 #9 — so the local
+        append is all the reference effectively does too)."""
+        if other is not self and other not in self.neighbors:
+            self.neighbors.append(other)
+
+    # -- BRB delivery flags (reference node/node.py:55-74) --
+    def reset_delivered_flag(self) -> None:
+        self._delivered.clear()
+
+    def wait_for_delivered(self, timeout: Optional[float] = None) -> bool:
+        """Block until the round's broadcasts were delivered to this peer.
+        Unlike the reference (no timeout: one silent peer stalls forever,
+        ``node/node.py:73``), defaults to the config round timeout."""
+        if timeout is None:
+            timeout = self.cluster.cfg.round_timeout_s
+        return self._delivered.wait(timeout)
+
+    # -- training / testing (reference node/node.py:315-326) --
+    def set_start_learning(self, rounds: int = 1, epochs: int = 5) -> None:
+        self.cluster._mark_trainer(self.node_id)
+
+    def testing(self) -> dict[str, Any]:
+        ev = self.cluster.last_record
+        if ev is None:
+            raise RuntimeError("no round has run yet")
+        return {"accuracy": ev.eval_acc, "addr": self.addr, "port": self.port}
+
+
+class Cluster:
+    """All peers of one experiment plus their Node handles."""
+
+    def __init__(self, cfg: Config, base_port: int = 7001, **experiment_kwargs: Any) -> None:
+        self.cfg = cfg
+        self.experiment = Experiment(cfg, **experiment_kwargs)
+        self.nodes = [Node(self, i, "127.0.0.1", base_port + i) for i in range(cfg.num_peers)]
+        self._pending_trainers: set[int] = set()
+        self._expected_trainers: Optional[list[int]] = None
+        self.last_record: Optional[RoundRecord] = None
+        self._lock = threading.Lock()
+
+    def sample_roles(self) -> tuple[list[Node], list[Node]]:
+        """Trainer/tester split for the next round (reference ``main.py:52-54``).
+        Resets any stale consent from an abandoned round: set_start_learning
+        calls only count toward the round they were sampled for."""
+        with self._lock:
+            self._pending_trainers.clear()
+        trainers = self.experiment.sample_roles().tolist()
+        self._expected_trainers = trainers
+        testers = [i for i in range(self.cfg.num_peers) if i not in trainers]
+        return [self.nodes[i] for i in trainers], [self.nodes[i] for i in testers]
+
+    def _mark_trainer(self, node_id: int) -> None:
+        run_now = False
+        with self._lock:
+            self._pending_trainers.add(node_id)
+            if self._expected_trainers is not None and self._pending_trainers >= set(
+                self._expected_trainers
+            ):
+                run_now = True
+        if run_now:
+            self._run_pending_round()
+
+    def _run_pending_round(self) -> None:
+        with self._lock:
+            trainers = self._expected_trainers
+            self._pending_trainers.clear()
+            self._expected_trainers = None
+        if trainers is None:
+            return
+        # Override the experiment's own sampling with the cluster's roles.
+        record = self._run_round_with(trainers)
+        self.last_record = record
+        failed = set(record.brb_failed_peers or [])
+        for node in self.nodes:
+            if node.node_id not in failed:
+                node._delivered.set()
+
+    def _run_round_with(self, trainers: list[int]) -> RoundRecord:
+        exp = self.experiment
+        sample = exp.sample_roles
+        import numpy as np
+
+        exp.sample_roles = lambda: np.asarray(sorted(trainers))  # type: ignore[assignment]
+        try:
+            return exp.run_round()
+        finally:
+            exp.sample_roles = sample  # type: ignore[assignment]
+
+    def run_round(self, trainers: Optional[list[int]] = None) -> RoundRecord:
+        """Drive one full round directly (the orchestration in
+        reference ``main.py:50-87`` collapsed into one call)."""
+        if trainers is None:
+            trainers = self.experiment.sample_roles().tolist()
+        self._expected_trainers = trainers
+        before = len(self.experiment.records)
+        for node in self.nodes:
+            node.reset_delivered_flag()
+        for t in trainers:
+            self.nodes[t].set_start_learning(rounds=1, epochs=self.cfg.local_epochs)
+        if len(self.experiment.records) == before:
+            raise RuntimeError("round did not execute (trainer set mismatch)")
+        return self.experiment.records[-1]

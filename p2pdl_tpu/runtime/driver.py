@@ -1,0 +1,236 @@
+"""The experiment driver: rounds, roles, trust plane, metrics.
+
+Equivalent of the reference's ``start_training`` orchestration loop
+(reference ``main.py:45-109``): per round it samples trainer/tester roles
+(``main.py:52-54``), runs local training + aggregation + global sync (here:
+one compiled device program instead of 3 trainer threads + pickled TCP
+fan-out + 4 sequential tester aggregations), runs the BRB trust plane over
+update fingerprints when enabled, evaluates, and records structured metrics
+(resurrecting the reference's dead ``save_results``, ``utils/log.py:4-21``,
+as JSONL that is actually written).
+
+Failure detection the reference lacks (its round stalls forever on one
+silent tester — ``node/node.py:73`` waits with no timeout, and
+``utils/waiting.py``'s 30 s timeout is inoperative, SURVEY §2 #13): BRB
+delivery here is checked against ``cfg.round_timeout_s`` and per-peer
+delivery failures are recorded rather than hanging the experiment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.data import make_federated_data
+from p2pdl_tpu.parallel import (
+    build_eval_fn,
+    build_round_fn,
+    init_peer_state,
+    make_mesh,
+    peer_sharding,
+)
+from p2pdl_tpu.protocol.brb import BRBConfig, Broadcaster
+from p2pdl_tpu.protocol.crypto import KeyServer, generate_key_pair
+from p2pdl_tpu.protocol.transport import InMemoryHub, brb_from_wire, brb_to_wire
+from p2pdl_tpu.utils.metrics import MetricsLogger
+
+
+@dataclasses.dataclass
+class RoundRecord:
+    round: int
+    trainers: list[int]
+    train_loss: float
+    eval_loss: float
+    eval_acc: float
+    duration_s: float
+    brb_delivered: Optional[int] = None  # peers that delivered all trainer broadcasts
+    brb_failed_peers: Optional[list[int]] = None
+    control_messages: Optional[int] = None
+    control_bytes: Optional[int] = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class _TrustPlane:
+    """Host-side BRB over update fingerprints for one experiment.
+
+    Each round, every trainer BRB-broadcasts the digest of its on-device
+    update fingerprint; every peer must deliver every trainer's broadcast.
+    Runs over the deterministic in-memory hub (the TCP transport serves the
+    multi-host control plane; simulation never needs sockets).
+    """
+
+    def __init__(self, cfg: Config, byz_ids: tuple[int, ...] = ()) -> None:
+        self.cfg = cfg
+        self.key_server = KeyServer()
+        self.hub = InMemoryHub()
+        self.byz_ids = set(byz_ids)
+        self.broadcasters: list[Broadcaster] = []
+        brb_cfg = BRBConfig(cfg.num_peers, cfg.byzantine_f)
+        self._keys = []
+        for pid in range(cfg.num_peers):
+            priv, pub = generate_key_pair()
+            self.key_server.register_key(pid, pub)
+            self._keys.append(priv)
+            self.broadcasters.append(Broadcaster(brb_cfg, pid, self.key_server, priv))
+        for pid in range(cfg.num_peers):
+            self.hub.register(pid, self._make_handler(pid))
+
+    def _make_handler(self, pid: int):
+        def handler(src: int, data: bytes) -> None:
+            msg = brb_from_wire(data)
+            if msg is None:
+                return
+            for out in self.broadcasters[pid].handle(msg):
+                self._fan_out(pid, out)
+
+        return handler
+
+    def _fan_out(self, src: int, msg) -> None:
+        # Fan out to every peer INCLUDING self: in Bracha each peer (the
+        # originator too) echoes, readies, and counts its own votes.
+        wire = brb_to_wire(msg)
+        for dst in range(self.cfg.num_peers):
+            self.hub.send(src, dst, wire)
+
+    def run_round(
+        self, round_idx: int, trainer_ids: list[int], fingerprints: np.ndarray
+    ) -> tuple[int, list[int]]:
+        """Broadcast each trainer's fingerprint; returns (#peers that
+        delivered every *honest* trainer's broadcast, ids of peers that did
+        not). Byzantine trainers equivocate: half the peers receive a forged
+        fingerprint — correct BRB then either delivers one payload
+        consistently or (echo vote split) delivers nothing; a Byzantine
+        trainer's broadcast is therefore excluded from the delivery check."""
+        for tid in trainer_ids:
+            payload = json.dumps(
+                {"round": round_idx, "trainer": tid, "fingerprint": fingerprints[tid].tolist()}
+            ).encode()
+            if tid in self.byz_ids:
+                forged = json.dumps(
+                    {"round": round_idx, "trainer": tid, "fingerprint": "forged"}
+                ).encode()
+                send_a, send_b = self.broadcasters[tid].broadcast_equivocating(
+                    round_idx, payload, forged
+                )
+                half = self.cfg.num_peers // 2
+                for dst in range(self.cfg.num_peers):
+                    wire = brb_to_wire(send_a if dst < half else send_b)
+                    self.hub.send(tid, dst, wire)
+            else:
+                for msg in self.broadcasters[tid].broadcast(round_idx, payload):
+                    self._fan_out(tid, msg)
+        deadline = time.monotonic() + self.cfg.round_timeout_s
+        while self.hub.pump() and time.monotonic() < deadline:
+            pass
+        honest_trainers = [t for t in trainer_ids if t not in self.byz_ids]
+        failed = []
+        for pid in range(self.cfg.num_peers):
+            ok = all(
+                self.broadcasters[pid].delivered(tid, round_idx) is not None
+                for tid in honest_trainers
+            )
+            if not ok:
+                failed.append(pid)
+        for bc in self.broadcasters:
+            bc.prune(round_idx)
+        return self.cfg.num_peers - len(failed), failed
+
+
+class Experiment:
+    """One configured federated experiment: data, state, compiled round."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        attack: str = "none",
+        byz_ids: tuple[int, ...] = (),
+        log_path: Optional[str] = None,
+        n_devices: Optional[int] = None,
+    ) -> None:
+        self.cfg = cfg
+        self.attack = attack
+        self.byz_ids = tuple(byz_ids)
+        self.mesh = make_mesh(n_devices)
+        self.data = make_federated_data(cfg)
+        self.round_fn = build_round_fn(cfg, self.mesh, attack=attack)
+        self.eval_fn = build_eval_fn(cfg)
+        self.metrics = MetricsLogger(log_path)
+        self.trust = _TrustPlane(cfg, byz_ids) if cfg.brb_enabled else None
+        self._role_rng = np.random.default_rng(cfg.seed)
+
+        sh = peer_sharding(self.mesh)
+        state = init_peer_state(cfg)
+        self.state = jax.tree.map(
+            lambda l: jax.device_put(l, sh) if getattr(l, "ndim", 0) >= 1 else l, state
+        )
+        self.x = jax.device_put(self.data.x, sh)
+        self.y = jax.device_put(self.data.y, sh)
+        byz_gate = np.zeros(cfg.num_peers, np.float32)
+        for i in self.byz_ids:
+            byz_gate[i] = 1.0
+        self.byz_gate = jnp.asarray(byz_gate)
+        self.records: list[RoundRecord] = []
+
+    def sample_roles(self) -> np.ndarray:
+        """Random trainer sample per round (reference ``main.py:52-54``)."""
+        return np.sort(
+            self._role_rng.choice(self.cfg.num_peers, self.cfg.trainers_per_round, replace=False)
+        )
+
+    def run_round(self) -> RoundRecord:
+        r = int(self.state.round_idx)
+        trainers = self.sample_roles()
+        t0 = time.perf_counter()
+        self.state, m = self.round_fn(
+            self.state,
+            self.x,
+            self.y,
+            jnp.asarray(trainers, jnp.int32),
+            self.byz_gate,
+            jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), r),
+        )
+        train_loss = float(jnp.mean(m["train_loss"]))
+
+        brb_delivered = brb_failed = msgs = nbytes = None
+        if self.trust is not None:
+            fingerprints = np.asarray(m["fingerprint"])
+            m0, b0 = self.trust.hub.messages_sent, self.trust.hub.bytes_sent
+            delivered, failed = self.trust.run_round(r, trainers.tolist(), fingerprints)
+            brb_delivered, brb_failed = delivered, failed
+            msgs = self.trust.hub.messages_sent - m0
+            nbytes = self.trust.hub.bytes_sent - b0
+
+        ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)
+        record = RoundRecord(
+            round=r,
+            trainers=trainers.tolist(),
+            train_loss=train_loss,
+            eval_loss=float(ev["eval_loss"]),
+            eval_acc=float(ev["eval_acc"]),
+            duration_s=time.perf_counter() - t0,
+            brb_delivered=brb_delivered,
+            brb_failed_peers=brb_failed,
+            control_messages=msgs,
+            control_bytes=nbytes,
+        )
+        self.records.append(record)
+        self.metrics.log(record.to_dict())
+        return record
+
+    def run(self) -> list[RoundRecord]:
+        for _ in range(self.cfg.rounds):
+            self.run_round()
+        return self.records
+
+
+def run_experiment(cfg: Config, **kwargs: Any) -> list[RoundRecord]:
+    return Experiment(cfg, **kwargs).run()

@@ -1,0 +1,104 @@
+"""HTTP orchestration facade.
+
+API parity with the reference's Flask app (reference ``main.py``):
+``POST /start_training`` runs the configured number of rounds and returns
+the per-round learning progress JSON (reference ``main.py:45-109``);
+``GET /status`` is the liveness probe (reference ``main.py:112-115``).
+Built on ``http.server`` (stdlib) so the framework adds no web-framework
+dependency; single worker thread — the driver is intentionally
+single-threaded (SURVEY §5 race-detection note).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.runtime.cluster import Cluster
+
+
+class OrchestratorState:
+    def __init__(self, cfg: Config, **experiment_kwargs) -> None:
+        self.cfg = cfg
+        self.cluster = Cluster(cfg, **experiment_kwargs)
+        self.lock = threading.Lock()
+        self.training = False
+
+    def start_training(self) -> dict:
+        """Run ``cfg.rounds`` rounds; returns learning progress per round
+        (reference ``main.py:96-109`` shape: accuracy per node per round)."""
+        with self.lock:
+            if self.training:
+                return {"error": "training already in progress"}
+            self.training = True
+        try:
+            progress = []
+            for _ in range(self.cfg.rounds):
+                record = self.cluster.run_round()
+                progress.append(
+                    {
+                        "round": record.round,
+                        "trainers": record.trainers,
+                        "train_loss": record.train_loss,
+                        "eval_loss": record.eval_loss,
+                        "accuracy": record.eval_acc,
+                        "duration_s": record.duration_s,
+                        "brb_delivered": record.brb_delivered,
+                    }
+                )
+            return {"status": "completed", "learning_progress": progress}
+        finally:
+            with self.lock:
+                self.training = False
+
+
+def make_handler(state: OrchestratorState):
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:
+            if self.path == "/status":
+                with state.lock:
+                    training = state.training
+                rounds_done = len(state.cluster.experiment.records)
+                self._reply(
+                    200,
+                    {
+                        "status": "training" if training else "idle",
+                        "rounds_completed": rounds_done,
+                        "num_peers": state.cfg.num_peers,
+                    },
+                )
+            else:
+                self._reply(404, {"error": "not found"})
+
+        def do_POST(self) -> None:
+            if self.path == "/start_training":
+                self._reply(200, state.start_training())
+            else:
+                self._reply(404, {"error": "not found"})
+
+        def log_message(self, *args) -> None:  # quiet
+            pass
+
+    return Handler
+
+
+def serve(
+    cfg: Config, host: str = "127.0.0.1", port: int = 5000, **experiment_kwargs
+) -> ThreadingHTTPServer:
+    """Start the orchestrator HTTP server (reference ``main.py:119`` runs on
+    port 5000); returns the server (caller controls serve_forever/shutdown)."""
+    state = OrchestratorState(cfg, **experiment_kwargs)
+    server = ThreadingHTTPServer((host, port), make_handler(state))
+    server.orchestrator = state  # type: ignore[attr-defined]
+    return server

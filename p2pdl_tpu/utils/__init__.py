@@ -1,0 +1,5 @@
+"""Host utilities: metrics logging, checkpointing, profiling."""
+
+from p2pdl_tpu.utils.metrics import MetricsLogger, save_results
+
+__all__ = ["MetricsLogger", "save_results"]

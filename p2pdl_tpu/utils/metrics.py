@@ -1,0 +1,44 @@
+"""Structured metrics logging.
+
+The reference has a JSON results appender that is imported but never called
+(reference ``utils/log.py:4-21``, imported at ``node/node.py:14`` — dead
+code, SURVEY §2 #12). This is that capability made real: JSONL (one record
+per line — append-safe, streaming-parseable, no read-modify-write of a
+growing JSON array like the reference attempts) plus an in-memory buffer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+
+class MetricsLogger:
+    def __init__(self, path: Optional[str] = None) -> None:
+        self.path = path
+        self.records: list[dict[str, Any]] = []
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def log(self, record: dict[str, Any]) -> None:
+        self.records.append(record)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+
+
+def save_results(result_data: dict[str, Any], result_file: str) -> None:
+    """Append one result record to a JSONL file (reference
+    ``utils/log.py:4-21`` parity, minus its corrupt-file JSON-array rewrite)."""
+    MetricsLogger(result_file).log(result_data)
+
+
+def load_results(result_file: str) -> list[dict[str, Any]]:
+    out = []
+    with open(result_file) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

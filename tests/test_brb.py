@@ -1,0 +1,259 @@
+"""BRB protocol tests: quorum math, delivery, Byzantine behavior, faults.
+
+Exercises the corrected Bracha state machine against the failure modes the
+reference cannot handle (hard-coded quorums at ``node/node.py:165,209``, no
+equivocation defense, delivery triggered by one multi-signature message)."""
+
+import hashlib
+
+import pytest
+
+from p2pdl_tpu.protocol.brb import BRBConfig, BRBMessage, Broadcaster, SEND
+from p2pdl_tpu.protocol.crypto import KeyServer, generate_key_pair, sign_data
+from p2pdl_tpu.protocol.transport import InMemoryHub, brb_from_wire, brb_to_wire
+
+
+def make_net(n, f, drop=None, corrupt=None):
+    ks = KeyServer()
+    hub = InMemoryHub(drop=drop, corrupt=corrupt)
+    bcs = []
+    privs = []
+    for pid in range(n):
+        priv, pub = generate_key_pair()
+        ks.register_key(pid, pub)
+        privs.append(priv)
+        bcs.append(Broadcaster(BRBConfig(n, f), pid, ks, priv))
+
+    def handler_for(pid):
+        def handler(src, data):
+            msg = brb_from_wire(data)
+            if msg is None:
+                return
+            for out in bcs[pid].handle(msg):
+                fan_out(pid, out)
+
+        return handler
+
+    def fan_out(src, msg):
+        # Include self: each peer (the originator too) counts its own votes.
+        wire = brb_to_wire(msg)
+        for dst in range(n):
+            hub.send(src, dst, wire)
+
+    for pid in range(n):
+        hub.register(pid, handler_for(pid))
+    return ks, hub, bcs, privs, fan_out
+
+
+def test_quorum_arithmetic():
+    cfg = BRBConfig(n=7, f=2)
+    assert cfg.echo_quorum == 5
+    assert cfg.ready_amplify == 3
+    assert cfg.deliver_quorum == 5
+    with pytest.raises(ValueError):
+        BRBConfig(n=6, f=2)  # needs n > 3f
+
+
+def test_all_honest_deliver():
+    n, f = 7, 2
+    _, hub, bcs, _, fan_out = make_net(n, f)
+    payload = b"round-1-update-digest"
+    for msg in bcs[0].broadcast(1, payload):
+        fan_out(0, msg)
+    hub.pump()
+    for pid in range(n):
+        assert bcs[pid].delivered(0, 1) == payload, f"peer {pid} did not deliver"
+
+
+def test_concurrent_broadcasts_do_not_interfere():
+    """Reference BRB counters are shared per-node fields reset between rounds
+    (``node/node.py:46-66``); ours are per-(sender, seq) instances."""
+    n, f = 4, 1
+    _, hub, bcs, _, fan_out = make_net(n, f)
+    for sender, payload in [(0, b"from-0"), (1, b"from-1"), (2, b"from-2")]:
+        for msg in bcs[sender].broadcast(7, payload):
+            fan_out(sender, msg)
+    hub.pump()
+    for pid in range(n):
+        assert bcs[pid].delivered(0, 7) == b"from-0"
+        assert bcs[pid].delivered(1, 7) == b"from-1"
+        assert bcs[pid].delivered(2, 7) == b"from-2"
+
+
+def test_forged_signature_rejected():
+    n, f = 4, 1
+    ks, hub, bcs, privs, fan_out = make_net(n, f)
+    outsider_priv, _ = generate_key_pair()  # not registered
+    payload = b"evil"
+    digest = hashlib.sha256(payload).digest()
+    msg = BRBMessage(SEND, 0, 1, 0, digest, payload)
+    forged = BRBMessage(
+        SEND, 0, 1, 0, digest, payload, sign_data(outsider_priv, msg.signing_bytes())
+    )
+    assert bcs[1].handle(forged) == []
+    assert bcs[1].delivered(0, 1) is None
+
+
+def test_equivocating_sender_never_splits_delivery():
+    """Byzantine sender sends payload A to half the peers, B to the rest:
+    no two honest peers may deliver different payloads."""
+    n, f = 7, 2
+    _, hub, bcs, privs, fan_out = make_net(n, f)
+    pa, pb = b"payload-A", b"payload-B"
+    da, db = hashlib.sha256(pa).digest(), hashlib.sha256(pb).digest()
+
+    def send_from_0(dst, digest, payload):
+        msg = BRBMessage(SEND, 0, 1, 0, digest, payload)
+        signed = BRBMessage(
+            SEND, 0, 1, 0, digest, payload, sign_data(privs[0], msg.signing_bytes())
+        )
+        for out in bcs[dst].handle(signed):
+            fan_out(dst, out)
+
+    for dst in range(1, 4):
+        send_from_0(dst, da, pa)
+    for dst in range(4, 7):
+        send_from_0(dst, db, pb)
+    hub.pump()
+    delivered = {bcs[pid].delivered(0, 1) for pid in range(1, n)}
+    delivered.discard(None)
+    assert len(delivered) <= 1, f"split-brain delivery: {delivered}"
+
+
+def test_mixed_digest_ready_quorum_cannot_split_brain():
+    """The digest-blind-counting attack: Byzantine sender 0 + Byzantine voter
+    1 try to make peer 6 (which never saw the honest SEND) assemble a mixed
+    READY quorum and deliver a conflicting payload B while peers 2-5 deliver
+    A. Per-digest vote counting must prevent it."""
+    n, f = 7, 2
+    ks, hub, bcs, privs, fan_out = make_net(n, f)
+    pa, pb = b"payload-A", b"payload-B"
+    da = hashlib.sha256(pa).digest()
+    dx = hashlib.sha256(b"bogus").digest()
+
+    def signed(kind, from_id, digest, payload=None):
+        m = BRBMessage(kind, 0, 1, from_id, digest, payload)
+        return BRBMessage(
+            kind, 0, 1, from_id, digest, payload,
+            sign_data(privs[from_id], m.signing_bytes()),
+        )
+
+    # Honest SEND(A) reaches peers 2..5 only; they run the full protocol.
+    for dst in range(2, 6):
+        for out in bcs[dst].handle(signed(SEND, 0, da, pa)):
+            fan_out(dst, out)
+    hub.pump()
+    # Byzantine 0 and 1 inject READYs for a *different* digest at peer 6.
+    from p2pdl_tpu.protocol.brb import READY
+
+    for byz in (0, 1):
+        bcs[6].handle(signed(READY, byz, dx))
+    # Byzantine sender now offers peer 6 payload B under yet another digest.
+    db = hashlib.sha256(pb).digest()
+    bcs[6].handle(signed(SEND, 0, db, pb))
+    delivered = {bcs[pid].delivered(0, 1) for pid in range(2, 7)}
+    delivered.discard(None)
+    assert delivered <= {pa}, f"split-brain: {delivered}"
+
+
+def test_duplicate_votes_not_double_counted():
+    """One peer echoing/readying twice (or with two digests) counts once."""
+    n, f = 4, 1
+    ks, hub, bcs, privs, fan_out = make_net(n, f)
+    payload = b"x"
+    digest = hashlib.sha256(payload).digest()
+
+    def signed(kind, from_id, digest):
+        m = BRBMessage(kind, 0, 1, from_id, digest)
+        return BRBMessage(
+            kind, 0, 1, from_id, digest, None,
+            sign_data(privs[from_id], m.signing_bytes()),
+        )
+
+    from p2pdl_tpu.protocol.brb import ECHO
+
+    inst_holder = bcs[2]
+    for _ in range(10):  # replay the same echo from peer 1
+        inst_holder.handle(signed(ECHO, 1, digest))
+    inst = inst_holder.instances[(0, 1)]
+    assert len(inst.echoes[digest]) == 1  # echo_quorum=3 never reached
+    assert not inst.sent_ready
+
+
+def test_broadcaster_prune():
+    n, f = 4, 1
+    _, hub, bcs, _, fan_out = make_net(n, f)
+    for seq in range(5):
+        for msg in bcs[0].broadcast(seq, b"p"):
+            fan_out(0, msg)
+    hub.pump()
+    assert len(bcs[1].instances) == 5
+    bcs[1].prune(before_seq=4)
+    assert len(bcs[1].instances) == 1
+    assert bcs[1].delivered(0, 4) == b"p"
+
+
+def test_equivocation_api_never_splits():
+    n, f = 7, 2
+    _, hub, bcs, _, fan_out = make_net(n, f)
+    a, b = bcs[0].broadcast_equivocating(1, b"A", b"B")
+    for dst in range(0, 4):
+        hub.send(0, dst, brb_to_wire(a))
+    for dst in range(4, 7):
+        hub.send(0, dst, brb_to_wire(b))
+    hub.pump()
+    delivered = {bcs[pid].delivered(0, 1) for pid in range(n)}
+    delivered.discard(None)
+    assert len(delivered) <= 1
+
+
+def test_message_drop_below_quorum_blocks_delivery():
+    """Drop everything to/from 3 of 7 peers: the remaining 4 < 2f+1=5 readies
+    cannot deliver — and the driver's timeout handles it (no hang)."""
+    n, f = 7, 2
+    dead = {4, 5, 6}
+
+    def drop(src, dst, data):
+        return src in dead or dst in dead
+
+    _, hub, bcs, _, fan_out = make_net(n, f, drop=drop)
+    for msg in bcs[0].broadcast(1, b"x"):
+        fan_out(0, msg)
+    hub.pump()
+    # echo quorum = ceil((7+2+1)/2) = 5 > 4 live peers -> nobody delivers
+    for pid in range(n):
+        assert bcs[pid].delivered(0, 1) is None
+
+
+def test_corrupted_wire_bytes_ignored():
+    n, f = 4, 1
+    _, hub, bcs, _, fan_out = make_net(
+        n, f, corrupt=lambda s, d, b: b[:-3] + b"zzz" if d == 2 else b
+    )
+    for msg in bcs[0].broadcast(1, b"x"):
+        fan_out(0, msg)
+    hub.pump()
+    # Peer 2 saw only garbage (json-corrupted) but others still deliver.
+    assert bcs[1].delivered(0, 1) == b"x"
+    assert bcs[3].delivered(0, 1) == b"x"
+
+
+def test_late_send_still_delivers():
+    """READY quorum can complete before the payload arrives; delivery must
+    happen when the SEND finally lands."""
+    n, f = 4, 1
+    block_send_to_3 = {"active": True}
+
+    def drop(src, dst, data):
+        return block_send_to_3["active"] and dst == 3 and b'"send"' in data
+
+    _, hub, bcs, privs, fan_out = make_net(n, f, drop=drop)
+    for msg in bcs[0].broadcast(1, b"late"):
+        fan_out(0, msg)
+    hub.pump()
+    assert bcs[3].delivered(0, 1) is None  # has readies, no payload
+    block_send_to_3["active"] = False
+    for msg in bcs[0].broadcast(1, b"late"):  # re-send
+        fan_out(0, msg)
+    hub.pump()
+    assert bcs[3].delivered(0, 1) == b"late"

@@ -1,0 +1,53 @@
+import jax.numpy as jnp
+import pytest
+
+from p2pdl_tpu.protocol.crypto import (
+    KeyServer,
+    digest_update,
+    generate_key_pair,
+    sign_data,
+    verify_signature,
+)
+
+
+def test_sign_verify_roundtrip():
+    priv, pub = generate_key_pair()
+    sig = sign_data(priv, b"hello")
+    assert verify_signature(pub, sig, b"hello")
+    assert not verify_signature(pub, sig, b"tampered")
+
+
+def test_wrong_key_rejected():
+    priv1, _ = generate_key_pair()
+    _, pub2 = generate_key_pair()
+    assert not verify_signature(pub2, sign_data(priv1, b"x"), b"x")
+
+
+def test_key_server_register_and_verify():
+    ks = KeyServer()
+    priv, pub = generate_key_pair()
+    ks.register_key(3, pub)
+    sig = sign_data(priv, b"payload")
+    assert ks.verify(3, sig, b"payload")
+    assert not ks.verify(3, sig, b"other")
+    assert not ks.verify(99, sig, b"payload")  # unknown peer
+
+
+def test_key_server_rejects_key_substitution():
+    ks = KeyServer()
+    _, pub1 = generate_key_pair()
+    _, pub2 = generate_key_pair()
+    ks.register_key(0, pub1)
+    ks.register_key(0, pub1)  # idempotent re-register OK
+    with pytest.raises(ValueError):
+        ks.register_key(0, pub2)
+
+
+def test_digest_update_canonical():
+    tree1 = {"a": jnp.ones((2, 2)), "b": jnp.zeros((3,))}
+    tree2 = {"b": jnp.zeros((3,)), "a": jnp.ones((2, 2))}  # same content
+    assert digest_update(tree1) == digest_update(tree2)
+    tree3 = {"a": jnp.ones((2, 2)), "b": jnp.ones((3,))}
+    assert digest_update(tree1) != digest_update(tree3)
+    # Shape matters even with identical bytes.
+    assert digest_update({"a": jnp.zeros((4,))}) != digest_update({"a": jnp.zeros((2, 2))})

@@ -1,0 +1,161 @@
+"""Runtime tests: experiment driver, Node/Cluster API parity, HTTP facade, CLI."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.runtime.cluster import Cluster
+from p2pdl_tpu.runtime.driver import Experiment
+from p2pdl_tpu.utils.metrics import load_results
+
+
+@pytest.fixture(scope="module")
+def small_cfg():
+    return Config(
+        num_peers=8,
+        trainers_per_round=3,
+        rounds=2,
+        local_epochs=1,
+        samples_per_peer=32,
+        batch_size=32,
+        lr=0.05,
+        server_lr=1.0,
+    )
+
+
+def test_experiment_runs_and_logs(small_cfg, tmp_path, mesh8):
+    log = str(tmp_path / "metrics.jsonl")
+    exp = Experiment(small_cfg, log_path=log)
+    records = exp.run()
+    assert len(records) == 2
+    assert records[1].round == 1
+    assert all(np.isfinite(r.train_loss) for r in records)
+    logged = load_results(log)
+    assert len(logged) == 2
+    assert logged[0]["trainers"] == records[0].trainers
+
+
+def test_experiment_with_brb_trust_plane(small_cfg, mesh8):
+    cfg = small_cfg.replace(brb_enabled=True, byzantine_f=2)
+    exp = Experiment(cfg)
+    record = exp.run_round()
+    assert record.brb_delivered == cfg.num_peers
+    assert record.brb_failed_peers == []
+    assert record.control_messages > 0
+    assert record.control_bytes > 0
+
+
+def test_trust_plane_catches_equivocating_trainer(small_cfg, mesh8):
+    """A Byzantine trainer equivocates its fingerprint broadcast: honest
+    trainers' broadcasts still deliver everywhere; the Byzantine one is
+    excluded (and would be flagged by the split echo vote)."""
+    cfg = small_cfg.replace(brb_enabled=True, byzantine_f=2)
+    exp = Experiment(cfg, byz_ids=(0,))
+    # Force trainer set to include the Byzantine peer.
+    exp.sample_roles = lambda: np.asarray([0, 1, 2])
+    record = exp.run_round()
+    # All peers deliver every honest trainer's broadcast.
+    assert record.brb_delivered == cfg.num_peers
+    # The equivocator's broadcast must not have split the mesh: no two peers
+    # delivered different payloads for (0, round).
+    payloads = {
+        bc.delivered(0, record.round) for bc in exp.trust.broadcasters
+    }
+    payloads.discard(None)
+    assert len(payloads) <= 1
+
+
+def test_cluster_node_api_parity(small_cfg, mesh8):
+    """The reference orchestration flow (main.py:50-87) through Node methods."""
+    cluster = Cluster(small_cfg.replace(brb_enabled=True))
+    nodes = cluster.nodes
+    assert len(nodes) == 8
+    for n in nodes:
+        n.start()
+    for a in nodes:
+        for b in nodes:
+            a.connect(b)
+    assert all(len(n.neighbors) == 7 for n in nodes)
+
+    trainers, testers = cluster.sample_roles()
+    assert len(trainers) == 3 and len(testers) == 5
+    for n in nodes:
+        n.reset_delivered_flag()
+    for t in trainers:
+        t.set_start_learning(rounds=1, epochs=1)
+    for tester in testers:
+        assert tester.wait_for_delivered(timeout=10.0)
+    result = testers[0].testing()
+    assert set(result) == {"accuracy", "addr", "port"}
+    assert 0.0 <= result["accuracy"] <= 1.0
+    for n in nodes:
+        n.stop()
+
+
+def test_cluster_run_round_direct(small_cfg, mesh8):
+    cluster = Cluster(small_cfg)
+    rec = cluster.run_round(trainers=[0, 1, 2])
+    assert rec.trainers == [0, 1, 2]
+
+
+def test_http_server_endpoints(small_cfg, mesh8):
+    from p2pdl_tpu.runtime.server import serve
+
+    server = serve(small_cfg.replace(rounds=1), port=0)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/status", timeout=10) as r:
+            status = json.loads(r.read())
+        assert status["status"] == "idle"
+        assert status["num_peers"] == 8
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/start_training", method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            result = json.loads(r.read())
+        assert result["status"] == "completed"
+        assert len(result["learning_progress"]) == 1
+        assert "accuracy" in result["learning_progress"][0]
+
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/status", timeout=10) as r:
+            status = json.loads(r.read())
+        assert status["rounds_completed"] == 1
+
+        bad = urllib.request.Request(f"http://127.0.0.1:{port}/nope", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(bad, timeout=10)
+        assert e.value.code == 404
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_cli_run(capsys, mesh8):
+    from p2pdl_tpu.cli import main
+
+    rc = main(
+        [
+            "run",
+            "--num-peers", "8", "--trainers-per-round", "3", "--rounds", "1",
+            "--local-epochs", "1", "--samples-per-peer", "32", "--brb",
+        ]
+    )
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.strip().splitlines() if l.startswith("{")]
+    rec = json.loads(lines[-1])
+    assert rec["round"] == 0
+    assert rec["brb_delivered"] == 8
+
+
+def test_cli_rejects_bad_flag(mesh8):
+    from p2pdl_tpu.cli import main
+
+    with pytest.raises(SystemExit):
+        main(["run", "--aggregator", "blockchain"])

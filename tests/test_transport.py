@@ -1,0 +1,102 @@
+import socket
+import threading
+import time
+
+from p2pdl_tpu.protocol.transport import (
+    InMemoryHub,
+    TCPTransport,
+    recv_frame,
+    send_frame,
+)
+
+
+def test_hub_fifo_and_stats():
+    hub = InMemoryHub()
+    got = []
+    hub.register(1, lambda src, data: got.append((src, data)))
+    hub.send(0, 1, b"a")
+    hub.send(0, 1, b"b")
+    assert hub.pump() == 2
+    assert got == [(0, b"a"), (0, b"b")]
+    assert hub.messages_sent == 2
+    assert hub.bytes_sent == 2
+
+
+def test_hub_drop_and_corrupt():
+    hub = InMemoryHub(
+        drop=lambda s, d, b: b == b"drop-me",
+        corrupt=lambda s, d, b: b.upper(),
+    )
+    got = []
+    hub.register(1, lambda src, data: got.append(data))
+    hub.send(0, 1, b"drop-me")
+    hub.send(0, 1, b"keep")
+    hub.pump()
+    assert got == [b"KEEP"]
+
+
+def test_framing_roundtrip():
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, b"hello world")
+        send_frame(a, b"")
+        assert recv_frame(b) == b"hello world"
+        assert recv_frame(b) == b""
+    finally:
+        a.close()
+        b.close()
+
+
+def test_framing_eof_returns_none():
+    a, b = socket.socketpair()
+    a.close()
+    try:
+        assert recv_frame(b) is None
+    finally:
+        b.close()
+
+
+def test_unframed_garbage_does_not_crash_receiver():
+    """The reference's connect() sends unframed pickles that parse as a ~2 GB
+    length and silently wedge the read (``node/node.py:259`` vs ``:99-102``).
+    Our receiver bounds the frame size and bails cleanly."""
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"\x80\x04\x95garbage-unframed-bytes")
+        a.close()
+        assert recv_frame(b) is None
+    finally:
+        b.close()
+
+
+def test_tcp_transport_end_to_end():
+    got = []
+    done = threading.Event()
+
+    def handler(src, data):
+        got.append((src, data))
+        done.set()
+
+    t1 = TCPTransport(1, "127.0.0.1", 0, handler)
+    t1.start()
+    t2 = TCPTransport(2, "127.0.0.1", 0, lambda s, d: None)
+    t2.start()
+    try:
+        t2.add_peer(1, "127.0.0.1", t1.port)
+        assert t2.send(1, b"over-the-wire")
+        assert done.wait(5.0)
+        assert got == [(2, b"over-the-wire")]
+        assert not t2.send(99, b"no-such-peer")
+    finally:
+        t1.stop()
+        t2.stop()
+
+
+def test_tcp_send_to_dead_peer_fails_cleanly():
+    t = TCPTransport(1, "127.0.0.1", 0, lambda s, d: None)
+    t.start()
+    try:
+        t.add_peer(2, "127.0.0.1", 1)  # nothing listens on port 1
+        assert t.send(2, b"x") is False
+    finally:
+        t.stop()
