@@ -48,6 +48,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attack", default="none", help="Byzantine attack for injected peers")
     p.add_argument("--byz-ids", default="", help="comma-separated adversarial peer ids")
     p.add_argument("--log-path", default=None, help="JSONL metrics output")
+    p.add_argument("--checkpoint-dir", default=None, help="checkpoint/resume directory")
+    p.add_argument("--checkpoint-every", type=int, default=1, help="rounds between checkpoints")
+    p.add_argument("--profile-dir", default=None, help="jax.profiler trace output dir")
     p.add_argument("--port", type=int, default=5000, help="HTTP port (serve mode)")
     p.add_argument("--n-devices", type=int, default=None, help="mesh size (default: all)")
     return p
@@ -121,10 +124,15 @@ def main(argv: list[str] | None = None) -> int:
     exp = Experiment(
         cfg, attack=args.attack, byz_ids=byz_ids,
         log_path=args.log_path, n_devices=args.n_devices,
+        checkpoint_dir=args.checkpoint_dir, checkpoint_every=args.checkpoint_every,
+        profile_dir=args.profile_dir,
     )
-    for _ in range(cfg.rounds):
-        record = exp.run_round()
-        print(json.dumps(record.to_dict()))
+    with exp.profiler.trace():
+        while int(exp.state.round_idx) < cfg.rounds:
+            record = exp.run_round()
+            print(json.dumps(record.to_dict()))
+    exp.save_checkpoint()
+    print(json.dumps({"profile": exp.profiler.summary()}))
     return 0
 
 

@@ -132,7 +132,7 @@ class Cluster:
         sample = exp.sample_roles
         import numpy as np
 
-        exp.sample_roles = lambda: np.asarray(sorted(trainers))  # type: ignore[assignment]
+        exp.sample_roles = lambda round_idx=None: np.asarray(sorted(trainers))  # type: ignore[assignment]
         try:
             return exp.run_round()
         finally:

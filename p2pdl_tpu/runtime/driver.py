@@ -40,6 +40,7 @@ from p2pdl_tpu.protocol.brb import BRBConfig, Broadcaster
 from p2pdl_tpu.protocol.crypto import KeyServer, generate_key_pair
 from p2pdl_tpu.protocol.transport import InMemoryHub, brb_from_wire, brb_to_wire
 from p2pdl_tpu.utils.metrics import MetricsLogger
+from p2pdl_tpu.utils.profiling import Profiler
 
 
 @dataclasses.dataclass
@@ -155,6 +156,9 @@ class Experiment:
         byz_ids: tuple[int, ...] = (),
         log_path: Optional[str] = None,
         n_devices: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 1,
+        profile_dir: Optional[str] = None,
     ) -> None:
         self.cfg = cfg
         self.attack = attack
@@ -165,10 +169,24 @@ class Experiment:
         self.eval_fn = build_eval_fn(cfg)
         self.metrics = MetricsLogger(log_path)
         self.trust = _TrustPlane(cfg, byz_ids) if cfg.brb_enabled else None
-        self._role_rng = np.random.default_rng(cfg.seed)
+        self.profiler = Profiler(profile_dir)
+
+        self.checkpointer = None
+        self.checkpoint_every = max(1, checkpoint_every)
+        # Experiment identity beyond the Config — validated on resume so a
+        # Byzantine run's checkpoint can't silently continue as an honest one.
+        self._ckpt_extra = {"attack": attack, "byz_ids": list(self.byz_ids)}
+        state = None
+        if checkpoint_dir is not None:
+            from p2pdl_tpu.utils.checkpoint import Checkpointer
+
+            self.checkpointer = Checkpointer(checkpoint_dir)
+            if self.checkpointer.latest_step() is not None:
+                state = self.checkpointer.restore(cfg, extra=self._ckpt_extra)
+        if state is None:
+            state = init_peer_state(cfg)
 
         sh = peer_sharding(self.mesh)
-        state = init_peer_state(cfg)
         self.state = jax.tree.map(
             lambda l: jax.device_put(l, sh) if getattr(l, "ndim", 0) >= 1 else l, state
         )
@@ -180,36 +198,46 @@ class Experiment:
         self.byz_gate = jnp.asarray(byz_gate)
         self.records: list[RoundRecord] = []
 
-    def sample_roles(self) -> np.ndarray:
-        """Random trainer sample per round (reference ``main.py:52-54``)."""
+    def sample_roles(self, round_idx: Optional[int] = None) -> np.ndarray:
+        """Random trainer sample per round (reference ``main.py:52-54``).
+
+        Keyed by ``(seed, round_idx)`` — not by a stateful generator — so a
+        resumed experiment samples the exact roles the uninterrupted run
+        would have (checkpoint/resume determinism)."""
+        if round_idx is None:
+            round_idx = int(self.state.round_idx)
+        rng = np.random.default_rng([self.cfg.seed, round_idx])
         return np.sort(
-            self._role_rng.choice(self.cfg.num_peers, self.cfg.trainers_per_round, replace=False)
+            rng.choice(self.cfg.num_peers, self.cfg.trainers_per_round, replace=False)
         )
 
     def run_round(self) -> RoundRecord:
         r = int(self.state.round_idx)
-        trainers = self.sample_roles()
+        trainers = self.sample_roles(r)
         t0 = time.perf_counter()
-        self.state, m = self.round_fn(
-            self.state,
-            self.x,
-            self.y,
-            jnp.asarray(trainers, jnp.int32),
-            self.byz_gate,
-            jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), r),
-        )
-        train_loss = float(jnp.mean(m["train_loss"]))
+        with self.profiler.phase("round"):
+            self.state, m = self.round_fn(
+                self.state,
+                self.x,
+                self.y,
+                jnp.asarray(trainers, jnp.int32),
+                self.byz_gate,
+                jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), r),
+            )
+            train_loss = float(jnp.mean(m["train_loss"]))
 
         brb_delivered = brb_failed = msgs = nbytes = None
         if self.trust is not None:
-            fingerprints = np.asarray(m["fingerprint"])
-            m0, b0 = self.trust.hub.messages_sent, self.trust.hub.bytes_sent
-            delivered, failed = self.trust.run_round(r, trainers.tolist(), fingerprints)
-            brb_delivered, brb_failed = delivered, failed
-            msgs = self.trust.hub.messages_sent - m0
-            nbytes = self.trust.hub.bytes_sent - b0
+            with self.profiler.phase("brb"):
+                fingerprints = np.asarray(m["fingerprint"])
+                m0, b0 = self.trust.hub.messages_sent, self.trust.hub.bytes_sent
+                delivered, failed = self.trust.run_round(r, trainers.tolist(), fingerprints)
+                brb_delivered, brb_failed = delivered, failed
+                msgs = self.trust.hub.messages_sent - m0
+                nbytes = self.trust.hub.bytes_sent - b0
 
-        ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)
+        with self.profiler.phase("eval"):
+            ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)
         record = RoundRecord(
             round=r,
             trainers=trainers.tolist(),
@@ -224,11 +252,31 @@ class Experiment:
         )
         self.records.append(record)
         self.metrics.log(record.to_dict())
+        if self.checkpointer is not None and (r + 1) % self.checkpoint_every == 0:
+            self.checkpointer.save(self.state, self.cfg, extra=self._ckpt_extra)
         return record
 
+    def save_checkpoint(self) -> None:
+        """Checkpoint the current state (no-op without a dir; idempotent —
+        skips if the current round is already the latest saved step)."""
+        if self.checkpointer is not None and self.checkpointer.latest_step() != int(
+            self.state.round_idx
+        ):
+            self.checkpointer.save(self.state, self.cfg, extra=self._ckpt_extra)
+
     def run(self) -> list[RoundRecord]:
-        for _ in range(self.cfg.rounds):
-            self.run_round()
+        """Run the remaining rounds (resume-aware: a restored experiment
+        continues from its checkpointed round, reference has no equivalent).
+
+        Always checkpoints the final state, whatever ``checkpoint_every`` —
+        otherwise tail rounds would be lost and a re-launch would re-execute
+        them, duplicating their JSONL metrics records. Device traces go to
+        ``profile_dir`` when configured (the ``jax.profiler`` trace wraps the
+        whole run here, not only in the CLI)."""
+        with self.profiler.trace():
+            while int(self.state.round_idx) < self.cfg.rounds:
+                self.run_round()
+        self.save_checkpoint()
         return self.records
 
 

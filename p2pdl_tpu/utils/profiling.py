@@ -1,0 +1,86 @@
+"""Tracing / profiling subsystem.
+
+The reference has none: no timers, no profiler hooks, no per-round timing
+anywhere — its only observability is ``logging`` of losses (SURVEY §5
+"tracing/profiling: ABSENT"). Here every driver phase (compiled round, BRB
+trust round, eval) runs under a named phase timer, aggregated into
+rounds/sec-grade statistics, and — when a trace directory is configured —
+under a ``jax.profiler`` trace whose output loads directly in TensorBoard /
+Perfetto for op-level TPU analysis (MXU utilization, HBM stalls, collective
+time on ICI).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Any, Iterator, Optional
+
+
+class PhaseStats:
+    __slots__ = ("count", "total_s", "min_s", "max_s")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total_s = 0.0
+        self.min_s = float("inf")
+        self.max_s = 0.0
+
+    def add(self, dt: float) -> None:
+        self.count += 1
+        self.total_s += dt
+        self.min_s = min(self.min_s, dt)
+        self.max_s = max(self.max_s, dt)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "count": self.count,
+            "total_s": self.total_s,
+            "mean_s": self.total_s / self.count if self.count else 0.0,
+            "min_s": self.min_s if self.count else 0.0,
+            "max_s": self.max_s,
+            "per_sec": self.count / self.total_s if self.total_s > 0 else 0.0,
+        }
+
+
+class Profiler:
+    """Named phase timers + optional ``jax.profiler`` device traces.
+
+    ``trace_dir=None`` keeps only the (near-free) host-side timers; with a
+    directory set, each phase also records a device trace named after the
+    phase. ``summary()`` returns per-phase stats — ``per_sec`` of the
+    ``"round"`` phase is the headline aggregation-rounds/sec metric.
+    """
+
+    def __init__(self, trace_dir: Optional[str] = None) -> None:
+        self.trace_dir = trace_dir
+        self.stats: dict[str, PhaseStats] = defaultdict(PhaseStats)
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        ctx: contextlib.AbstractContextManager = contextlib.nullcontext()
+        if self.trace_dir is not None:
+            import jax.profiler
+
+            ctx = jax.profiler.TraceAnnotation(name)
+        t0 = time.perf_counter()
+        try:
+            with ctx:
+                yield
+        finally:
+            self.stats[name].add(time.perf_counter() - t0)
+
+    @contextlib.contextmanager
+    def trace(self) -> Iterator[None]:
+        """Whole-run device trace (wrap the experiment's ``run()``)."""
+        if self.trace_dir is None:
+            yield
+            return
+        import jax.profiler
+
+        with jax.profiler.trace(self.trace_dir):
+            yield
+
+    def summary(self) -> dict[str, dict[str, Any]]:
+        return {name: s.to_dict() for name, s in sorted(self.stats.items())}

@@ -1,0 +1,151 @@
+"""Checkpoint/resume: roundtrip fidelity and resumed-trajectory determinism.
+
+The reference has no persistence (SURVEY §5 "checkpoint/resume: ABSENT");
+these tests pin the capability we add: exact state roundtrip, config
+mismatch rejection, and — the property that matters — a crashed-and-resumed
+experiment reproducing the uninterrupted run bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.parallel.peer_state import PeerState, init_peer_state
+from p2pdl_tpu.runtime.driver import Experiment
+from p2pdl_tpu.utils.checkpoint import Checkpointer
+
+TINY = Config(
+    num_peers=8,
+    trainers_per_round=3,
+    rounds=4,
+    local_epochs=1,
+    samples_per_peer=16,
+    batch_size=8,
+    model="mlp",
+    dataset="synthetic",
+)
+
+
+def _trees_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+def test_roundtrip_exact(tmp_path):
+    state = init_peer_state(TINY)
+    ck = Checkpointer(str(tmp_path / "ckpt"))
+    step = ck.save(state, TINY)
+    assert step == 0
+    assert ck.latest_step() == 0
+    restored = ck.restore(TINY)
+    assert _trees_equal(state.params, restored.params)
+    assert _trees_equal(state.opt_state, restored.opt_state)
+    assert np.array_equal(np.asarray(state.rng), np.asarray(restored.rng))
+    assert int(restored.round_idx) == 0
+
+
+def test_config_mismatch_rejected(tmp_path):
+    state = init_peer_state(TINY)
+    ck = Checkpointer(str(tmp_path / "ckpt"))
+    ck.save(state, TINY)
+    other = TINY.replace(lr=0.5)
+    with pytest.raises(ValueError, match="lr"):
+        ck.restore(other)
+
+
+def test_resume_allows_extended_rounds(tmp_path):
+    """Raising ``rounds`` is the canonical resume (extend the experiment);
+    only state-shaping fields must match."""
+    state = init_peer_state(TINY)
+    ck = Checkpointer(str(tmp_path / "ckpt"))
+    ck.save(state, TINY)
+    extended = TINY.replace(rounds=TINY.rounds + 4)
+    restored = ck.restore(extended)
+    assert _trees_equal(state.params, restored.params)
+
+
+def test_resume_rejects_different_attack(tmp_path):
+    """A Byzantine run's checkpoint must not silently continue as honest:
+    attack/byz_ids are Experiment args (not Config fields) but are saved and
+    validated as checkpoint identity."""
+    ckdir = str(tmp_path / "ckpt")
+    byz = Experiment(TINY, attack="sign_flip", byz_ids=(0,), checkpoint_dir=ckdir)
+    byz.run_round()
+    with pytest.raises(ValueError, match="attack"):
+        Experiment(TINY, checkpoint_dir=ckdir)
+
+
+def test_final_state_checkpointed_with_sparse_cadence(tmp_path):
+    """checkpoint_every=3 with rounds=4: tail rounds still checkpoint at run
+    end, so a re-launch does not re-execute (and re-log) them."""
+    ckdir = str(tmp_path / "ckpt")
+    exp = Experiment(TINY, checkpoint_dir=ckdir, checkpoint_every=3)
+    exp.run()
+    assert exp.checkpointer.latest_step() == TINY.rounds
+    resumed = Experiment(TINY, checkpoint_dir=ckdir, checkpoint_every=3)
+    assert resumed.run() == []  # nothing left to run, no duplicate records
+
+
+def test_missing_checkpoint_raises(tmp_path):
+    ck = Checkpointer(str(tmp_path / "empty"))
+    assert ck.latest_step() is None
+    with pytest.raises(FileNotFoundError):
+        ck.restore(TINY)
+
+
+def test_retention_keeps_latest(tmp_path):
+    ck = Checkpointer(str(tmp_path / "ckpt"), keep=2)
+    state = init_peer_state(TINY)
+    for r in range(4):
+        ck.save(dataclasses.replace(state, round_idx=jnp.asarray(r, jnp.int32)), TINY)
+    assert ck.latest_step() == 3
+    restored = ck.restore(TINY, step=3)
+    assert int(restored.round_idx) == 3
+
+
+def test_resume_matches_uninterrupted_run(tmp_path):
+    # Uninterrupted: 4 rounds straight.
+    full = Experiment(TINY)
+    full_records = full.run()
+    assert len(full_records) == 4
+
+    # Interrupted: 2 rounds with checkpointing, then a brand-new process
+    # (fresh Experiment) resumes from the checkpoint for the rest.
+    ckdir = str(tmp_path / "ckpt")
+    first = Experiment(TINY, checkpoint_dir=ckdir)
+    first.run_round()
+    first.run_round()
+    # Step = post-round round_idx: after rounds 0 and 1 the latest step is 2.
+    assert first.checkpointer.latest_step() == 2
+
+    resumed = Experiment(TINY, checkpoint_dir=ckdir)
+    assert int(resumed.state.round_idx) == 2
+    resumed_records = resumed.run()
+    assert [r.round for r in resumed_records] == [2, 3]
+
+    # Same roles, same losses, same final params as the uninterrupted run.
+    for a, b in zip(full_records[2:], resumed_records):
+        assert a.trainers == b.trainers
+        assert np.isclose(a.train_loss, b.train_loss, rtol=1e-6)
+        assert np.isclose(a.eval_loss, b.eval_loss, rtol=1e-6)
+    assert _trees_equal(full.state.params, resumed.state.params)
+
+
+def test_profiler_phase_stats():
+    from p2pdl_tpu.utils.profiling import Profiler
+
+    p = Profiler()
+    for _ in range(3):
+        with p.phase("round"):
+            pass
+    s = p.summary()
+    assert s["round"]["count"] == 3
+    assert s["round"]["per_sec"] > 0

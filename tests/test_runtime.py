@@ -56,7 +56,7 @@ def test_trust_plane_catches_equivocating_trainer(small_cfg, mesh8):
     cfg = small_cfg.replace(brb_enabled=True, byzantine_f=2)
     exp = Experiment(cfg, byz_ids=(0,))
     # Force trainer set to include the Byzantine peer.
-    exp.sample_roles = lambda: np.asarray([0, 1, 2])
+    exp.sample_roles = lambda round_idx=None: np.asarray([0, 1, 2])
     record = exp.run_round()
     # All peers deliver every honest trainer's broadcast.
     assert record.brb_delivered == cfg.num_peers
@@ -149,8 +149,13 @@ def test_cli_run(capsys, mesh8):
     )
     assert rc == 0
     lines = [l for l in capsys.readouterr().out.strip().splitlines() if l.startswith("{")]
-    rec = json.loads(lines[-1])
+    records = [json.loads(l) for l in lines]
+    rounds = [r for r in records if "round" in r]
+    rec = rounds[-1]
     assert rec["round"] == 0
+    # The CLI also emits a profiling summary (SURVEY §5 tracing subsystem).
+    profiles = [r for r in records if "profile" in r]
+    assert profiles and profiles[-1]["profile"]["round"]["count"] == 1
     assert rec["brb_delivered"] == 8
 
 
