@@ -19,11 +19,12 @@ class TransformerBlock(nn.Module):
     dim: int
     heads: int
     mlp_ratio: int = 4
+    attn_impl: str = "dense"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         y = nn.LayerNorm()(x)
-        x = x + MultiHeadAttention(self.dim, self.heads)(y)
+        x = x + MultiHeadAttention(self.dim, self.heads, impl=self.attn_impl)(y)
         y = nn.LayerNorm()(x)
         y = nn.Dense(self.dim * self.mlp_ratio)(y)
         y = nn.gelu(y)
@@ -37,6 +38,7 @@ class ViTTiny(nn.Module):
     depth: int = 12
     heads: int = 3
     num_classes: int = 10
+    attn_impl: str = "dense"  # "flash" fuses attention via Pallas on TPU
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -49,6 +51,6 @@ class ViTTiny(nn.Module):
             "pos_embed", nn.initializers.normal(0.02), (1, x.shape[1], self.dim)
         )
         for _ in range(self.depth):
-            x = TransformerBlock(self.dim, self.heads)(x)
+            x = TransformerBlock(self.dim, self.heads, attn_impl=self.attn_impl)(x)
         x = nn.LayerNorm()(x)
         return nn.Dense(self.num_classes)(x[:, 0])

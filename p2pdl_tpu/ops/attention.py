@@ -19,6 +19,7 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = False) -
     """Scaled dot-product attention. ``q,k,v``: [B, H, T, D]."""
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    mask = None
     if causal:
         t_q, t_k = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
@@ -26,6 +27,10 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = False) -
     weights = jnp.asarray(
         nn.softmax(logits.astype(jnp.float32), axis=-1), dtype=q.dtype
     )
+    if mask is not None:
+        # Fully-masked query rows (possible when t_q > t_k) output zero, not
+        # a uniform average of v — consistent with the fused flash kernel.
+        weights = jnp.where(mask.any(axis=-1)[:, None], weights, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
 
 
@@ -42,6 +47,7 @@ class MultiHeadAttention(nn.Module):
     heads: int
     causal: bool = False
     seq_axis: str | None = None
+    impl: str = "dense"  # "dense" | "flash" (fused Pallas kernels)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -51,10 +57,16 @@ class MultiHeadAttention(nn.Module):
         qkv = qkv.reshape(b, t, 3, self.heads, head_dim)
         q, k, v = jnp.moveaxis(qkv, 2, 0)  # each [B, T, H, D]
         q, k, v = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))  # [B, H, T, D]
+        if self.impl not in ("dense", "flash"):
+            raise ValueError(f"unknown attention impl {self.impl!r}; one of ('dense', 'flash')")
         if self.seq_axis is not None:
             from p2pdl_tpu.ops.ring_attention import ring_attention
 
             out = ring_attention(q, k, v, self.seq_axis, causal=self.causal)
+        elif self.impl == "flash":
+            from p2pdl_tpu.ops.pallas_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=self.causal)
         else:
             out = sdpa(q, k, v, causal=self.causal)
         out = jnp.swapaxes(out, 1, 2).reshape(b, t, self.dim)

@@ -43,10 +43,13 @@ def ring_attention(
     q32 = q.astype(jnp.float32) * scale
 
     # Running flash-attention accumulators, tagged as varying over the mesh
-    # axis (pvary) so the scan carry types match the block-dependent updates.
-    o = lax.pvary(jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32), axis_name)
-    m = lax.pvary(jnp.full(q.shape[:3], -jnp.inf, jnp.float32), axis_name)
-    l = lax.pvary(jnp.zeros(q.shape[:3], jnp.float32), axis_name)
+    # axis so the scan carry types match the block-dependent updates.
+    def _vary(x):
+        return lax.pcast(x, axis_name, to="varying")
+
+    o = _vary(jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32))
+    m = _vary(jnp.full(q.shape[:3], -jnp.inf, jnp.float32))
+    l = _vary(jnp.zeros(q.shape[:3], jnp.float32))
 
     # Pass k/v to the next device each step; after s steps we hold the block
     # originally owned by (my_idx - s) mod n_dev.

@@ -15,7 +15,6 @@ resume can verify it is continuing the same experiment.
 from __future__ import annotations
 
 import dataclasses
-import json
 import os
 from typing import Any, Optional
 
@@ -157,21 +156,3 @@ RESUME_COMPATIBLE_FIELDS = ("rounds", "round_timeout_s", "brb_enabled")
 def _config_diff(a: Config, b: Config) -> dict[str, tuple[Any, Any]]:
     da, db = dataclasses.asdict(a), dataclasses.asdict(b)
     return {k: (da[k], db[k]) for k in da if da[k] != db[k]}
-
-
-def save_experiment_meta(directory: str, meta: dict[str, Any]) -> None:
-    """Sidecar experiment metadata (records so far, wall-clock, etc.)."""
-    os.makedirs(directory, exist_ok=True)
-    path = os.path.join(directory, "experiment.json")
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(meta, f)
-    os.replace(tmp, path)
-
-
-def load_experiment_meta(directory: str) -> Optional[dict[str, Any]]:
-    path = os.path.join(directory, "experiment.json")
-    if not os.path.exists(path):
-        return None
-    with open(path) as f:
-        return json.load(f)

@@ -1,0 +1,117 @@
+"""Flash-attention Pallas kernels vs. the dense reference.
+
+Forward and backward (custom VJP) must match ``sdpa`` — the dense
+softmax(QK^T)V — to float32 tolerance, for causal and full attention,
+with and without sequence lengths that don't divide the block size.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pdl_tpu.ops.attention import sdpa
+from p2pdl_tpu.ops.pallas_attention import flash_attention
+
+
+def _rand_qkv(key, b=2, h=2, t=64, d=32, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (b, h, t, d)
+    return (
+        jax.random.normal(kq, shape, dtype),
+        jax.random.normal(kk, shape, dtype),
+        jax.random.normal(kv, shape, dtype),
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("t", [64, 48])  # 48: does not divide block 32
+def test_forward_matches_dense(causal, t):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), t=t)
+    dense = sdpa(q, k, v, causal=causal)
+    fused = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(dense), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_backward_matches_dense(causal):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), t=48, d=16)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(sdpa(q, k, v, causal=causal) ** 2)
+
+    def loss_fused(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=16, block_k=16) ** 2)
+
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gd, gf):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=5e-4, rtol=1e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("tq,tk", [(16, 48), (48, 16), (1, 64)])
+def test_rectangular_matches_dense(causal, tq, tk):
+    """t_q != t_k (e.g. decode-with-KV-cache shapes) — the sdpa contract."""
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (2, 2, tq, 16))
+    k = jax.random.normal(kk, (2, 2, tk, 16))
+    v = jax.random.normal(kv, (2, 2, tk, 16))
+    dense = sdpa(q, k, v, causal=causal)
+    fused = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(dense), atol=2e-5)
+
+    def loss_d(q, k, v):
+        return jnp.sum(sdpa(q, k, v, causal=causal) ** 2)
+
+    def loss_f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=16, block_k=16) ** 2)
+
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gd, gf):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=5e-4, rtol=1e-3)
+
+
+def test_unknown_impl_raises():
+    from p2pdl_tpu.ops.attention import MultiHeadAttention
+
+    x = jnp.zeros((1, 8, 16))
+    with pytest.raises(ValueError, match="unknown attention impl"):
+        MultiHeadAttention(16, 2, impl="Flash").init(jax.random.PRNGKey(0), x)
+
+
+def test_bf16_inputs_close():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), t=32, dtype=jnp.bfloat16)
+    dense = sdpa(q, k, v).astype(jnp.float32)
+    fused = flash_attention(q, k, v, block_q=16, block_k=16).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(dense), atol=3e-2, rtol=3e-2)
+
+
+def test_jit_and_vmap_compose():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), b=1, h=1, t=32, d=8)
+    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, block_q=16, block_k=16))
+    out = f(q, k, v)
+    assert out.shape == q.shape
+    # Stacked experiments (vmap over a leading axis) must trace through.
+    qs = jnp.stack([q, q])
+    ks = jnp.stack([k, k])
+    vs = jnp.stack([v, v])
+    outs = jax.vmap(f)(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(out), atol=1e-6)
+
+
+def test_vit_flash_impl_matches_dense():
+    """ViT with attn_impl='flash' must produce the same logits as dense."""
+    from p2pdl_tpu.models.vit import ViTTiny
+
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 32, 32, 3))
+    dense_model = ViTTiny(depth=2, attn_impl="dense")
+    flash_model = ViTTiny(depth=2, attn_impl="flash")
+    params = dense_model.init(jax.random.PRNGKey(5), x)
+    out_d = dense_model.apply(params, x)
+    out_f = flash_model.apply(params, x)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d), atol=2e-4, rtol=1e-4)
