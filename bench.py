@@ -30,6 +30,7 @@ from p2pdl_tpu.parallel import (
     init_peer_state,
     make_mesh,
     peer_sharding,
+    shard_state,
 )
 
 NORTH_STAR_ROUNDS_PER_SEC = 50.0
@@ -47,11 +48,8 @@ def bench_rounds_per_sec(num_peers: int = 1024, timed_rounds: int = 20) -> float
     )
     mesh = make_mesh()
     data = make_federated_data(cfg, eval_samples=16)
-    state = init_peer_state(cfg)
+    state = shard_state(init_peer_state(cfg), cfg, mesh)
     sh = peer_sharding(mesh)
-    state = jax.tree.map(
-        lambda l: jax.device_put(l, sh) if getattr(l, "ndim", 0) >= 1 else l, state
-    )
     x = jax.device_put(data.x, sh)
     y = jax.device_put(data.y, sh)
 

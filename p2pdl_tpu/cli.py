@@ -45,6 +45,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute-dtype", default="bfloat16")
     p.add_argument("--param-dtype", default="float32")
     p.add_argument("--remat", action="store_true")
+    p.add_argument(
+        "--attn-impl",
+        choices=["dense", "flash"],
+        default="dense",
+        help="attention implementation for transformer models "
+        "(flash = fused Pallas TPU kernels)",
+    )
     p.add_argument("--attack", default="none", help="Byzantine attack for injected peers")
     p.add_argument("--byz-ids", default="", help="comma-separated adversarial peer ids")
     p.add_argument("--log-path", default=None, help="JSONL metrics output")
@@ -53,6 +60,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None, help="jax.profiler trace output dir")
     p.add_argument("--port", type=int, default=5000, help="HTTP port (serve mode)")
     p.add_argument("--n-devices", type=int, default=None, help="mesh size (default: all)")
+    p.add_argument(
+        "--platform",
+        default=None,
+        choices=["cpu", "tpu"],
+        help="force the JAX platform; needed because an environment may pin "
+        "JAX to a TPU backend at interpreter start, in which case "
+        "JAX_PLATFORMS=cpu in the env arrives too late — this flag applies "
+        "jax.config.update before any device is touched",
+    )
     return p
 
 
@@ -82,11 +98,18 @@ def config_from_args(args: argparse.Namespace) -> Config:
         compute_dtype=args.compute_dtype,
         param_dtype=args.param_dtype,
         remat=args.remat,
+        attn_impl=args.attn_impl,
     )
 
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.platform is not None:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+        if args.platform == "cpu" and args.n_devices is not None:
+            jax.config.update("jax_num_cpu_devices", args.n_devices)
     cfg = config_from_args(args)
     byz_ids = tuple(int(x) for x in args.byz_ids.split(",") if x.strip())
 

@@ -82,6 +82,9 @@ class Config:
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     remat: bool = False
+    # Attention implementation for transformer models ("dense" | "flash";
+    # flash = fused Pallas TPU kernels, ops/pallas_attention.py).
+    attn_impl: str = "dense"
 
     def __post_init__(self) -> None:
         if self.num_peers < 2:
@@ -101,6 +104,15 @@ class Config:
             raise ValueError(f"unknown dataset {self.dataset!r}; one of {DATASETS}")
         if self.partition not in PARTITIONS:
             raise ValueError(f"unknown partition {self.partition!r}; one of {PARTITIONS}")
+        if self.attn_impl not in ("dense", "flash"):
+            raise ValueError(
+                f"unknown attn_impl {self.attn_impl!r}; one of ('dense', 'flash')"
+            )
+        if self.attn_impl == "flash" and self.model != "vit_tiny":
+            raise ValueError(
+                f"attn_impl='flash' requires an attention model (vit_tiny); "
+                f"model={self.model!r} has no attention"
+            )
         if not (0.0 <= self.trimmed_mean_beta < 0.5):
             raise ValueError(f"trimmed_mean_beta must be in [0, 0.5), got {self.trimmed_mean_beta}")
         if self.samples_per_peer < self.batch_size:

@@ -31,7 +31,15 @@ NEG_INF = float("-inf")
 
 
 def _auto_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    """Compile on any TPU device, interpret elsewhere (CPU tests).
+
+    Keyed on the device, not the backend *name*: TPU PJRT plugins can be
+    registered under a different platform name (this image's tunnel registers
+    the TPU as platform "axon"), and interpret mode there would silently run
+    the kernels in the Python-level Pallas interpreter on real hardware.
+    """
+    dev = jax.devices()[0]
+    return not ("tpu" in dev.platform.lower() or "tpu" in dev.device_kind.lower())
 
 
 def _fwd_kernel(

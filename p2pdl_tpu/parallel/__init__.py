@@ -2,14 +2,21 @@
 
 Where the reference runs N peers as N threads exchanging pickled TCP messages
 (reference ``node/node.py:81-112``, ``main.py:24-36``), this package puts the
-peer axis on the device mesh: peer state is a pytree with a leading
-``num_peers`` dimension sharded over a ``jax.sharding.Mesh`` axis, local
+peer axis on the device mesh: per-peer state (data shards, PRNG keys,
+optimizer state) is sharded over a ``jax.sharding.Mesh`` axis, the global
+model is stored once (see ``peer_state`` for the layout rationale), local
 training is a vmapped ``lax.scan`` under one ``jit``, and every exchange is
 an XLA collective over ICI.
 """
 
 from p2pdl_tpu.parallel.mesh import make_mesh, peer_sharding, peers_per_device
-from p2pdl_tpu.parallel.peer_state import PeerState, init_peer_state
+from p2pdl_tpu.parallel.peer_state import (
+    PeerState,
+    global_params,
+    init_peer_state,
+    params_layout,
+    shard_state,
+)
 from p2pdl_tpu.parallel.round import build_round_fn, build_eval_fn
 
 __all__ = [
@@ -18,6 +25,9 @@ __all__ = [
     "peers_per_device",
     "PeerState",
     "init_peer_state",
+    "shard_state",
+    "global_params",
+    "params_layout",
     "build_round_fn",
     "build_eval_fn",
 ]

@@ -1,8 +1,26 @@
 """Peer-stacked training state.
 
 The reference's per-node state (model + SGD optimizer + loss constructed in
-``Node.__init__``, reference ``node/node.py:22-31``) becomes one pytree with
-a leading peer dimension, built under ``jit`` with per-peer PRNG keys.
+``Node.__init__``, reference ``node/node.py:22-31``) becomes one pytree,
+built under ``jit`` with per-peer PRNG keys.
+
+Two parameter layouts, chosen by the aggregation topology:
+
+- **sync** (fedavg / robust reducers / secure_fedavg): the global model is
+  stored ONCE (no peer dimension). Peers' parameters are provably identical
+  at every round boundary — synchronized init plus a uniform server update —
+  so peer-stacking them would store (and stream through HBM every round)
+  ``num_peers`` copies of the same bytes. Per-peer copies exist only
+  transiently inside the compiled round while local SGD diverges them.
+  This is the key deviation from the reference's layout, where every node
+  holds its own full model replica (reference ``node/node.py:22-29``) and
+  every round moves all of them.
+- **peer** (gossip): truly decentralized — peers' models genuinely differ
+  across rounds, so every array leaf leads with ``num_peers``.
+
+Per-peer optimizer state is kept in both layouts (each node owns its
+optimizer for the experiment's lifetime, reference ``node/node.py:30``;
+with plain SGD the state is empty and costs nothing).
 
 Deliberate deviation (documented, per SURVEY §7): the reference gives every
 node an *independent random init* and still averages deltas across them
@@ -24,17 +42,27 @@ import optax
 
 from p2pdl_tpu.config import Config
 from p2pdl_tpu.models import get_model, init_params, model_input_spec
+from p2pdl_tpu.parallel.mesh import peer_sharding, replicated_sharding
 
 
 @flax.struct.dataclass
 class PeerState:
-    """All mutable experiment state; every array leaf leads with ``num_peers``
-    except ``round_idx``."""
+    """All mutable experiment state.
 
-    params: Any  # pytree, leaves [P, ...]
-    opt_state: Any  # pytree, leaves [P, ...]
+    ``params``: global pytree (sync layout) or ``[P, ...]``-stacked (peer
+    layout). ``opt_state``/``rng`` always lead with ``num_peers``;
+    ``round_idx`` is a replicated scalar.
+    """
+
+    params: Any
+    opt_state: Any
     rng: jax.Array  # [P] peer PRNG keys (uint32 typed key array)
     round_idx: jax.Array  # scalar int32, replicated
+
+
+def params_layout(cfg: Config) -> str:
+    """``"peer"`` (stacked) for gossip, ``"sync"`` (single copy) otherwise."""
+    return "peer" if cfg.aggregator == "gossip" else "sync"
 
 
 def make_optimizer(cfg: Config) -> optax.GradientTransformation:
@@ -50,6 +78,8 @@ def build_model(cfg: Config):
         from p2pdl_tpu.data.synthetic import SHAKESPEARE_VOCAB_SIZE
 
         kwargs["vocab_size"] = SHAKESPEARE_VOCAB_SIZE
+    if cfg.model == "vit_tiny":
+        kwargs["attn_impl"] = cfg.attn_impl
     return get_model(cfg.model, **kwargs)
 
 
@@ -72,12 +102,38 @@ def init_peer_state(cfg: Config, key: jax.Array | None = None) -> PeerState:
     def stack(leaf):
         return jnp.broadcast_to(leaf[None], (cfg.num_peers, *leaf.shape))
 
+    if params_layout(cfg) == "peer":
+        params = jax.tree.map(stack, params)
     return PeerState(
-        params=jax.tree.map(stack, params),
+        params=params,
         opt_state=jax.tree.map(stack, opt_state),
         rng=jax.random.split(peer_key, cfg.num_peers),
         round_idx=jnp.zeros((), jnp.int32),
     )
+
+
+def shard_state(state: PeerState, cfg: Config, mesh) -> PeerState:
+    """Place a ``PeerState`` on the mesh with the layout-correct shardings."""
+    ps = peer_sharding(mesh)
+    rs = replicated_sharding(mesh)
+    layout = params_layout(cfg)
+    shardings = PeerState(
+        params=jax.tree.map(lambda _: ps if layout == "peer" else rs, state.params),
+        opt_state=jax.tree.map(
+            lambda l: ps if getattr(l, "ndim", 0) >= 1 else rs, state.opt_state
+        ),
+        rng=ps,
+        round_idx=rs,
+    )
+    return jax.device_put(state, shardings)
+
+
+def global_params(state: PeerState, cfg: Config) -> Any:
+    """The synchronized global model: the single stored copy (sync layout)
+    or peer 0's slice (peer layout, where "global" is per-peer)."""
+    if params_layout(cfg) == "sync":
+        return state.params
+    return jax.tree.map(lambda l: l[0], state.params)
 
 
 def params_bytes(params: Any) -> int:

@@ -18,6 +18,18 @@ single ``jit``-compiled ``shard_map`` over the peer mesh axis:
   reference's nondeterministic last-writer-wins broadcast (SURVEY §3.4) with
   a deterministic update — a documented, deliberate fix.
 
+Bandwidth architecture (the perf ceiling is HBM traffic, not FLOPs): in the
+sync layout the global params live in ONE copy (see ``peer_state``), so the
+cross-round working set is megabytes, not ``num_peers`` × model. Per-peer
+parameter copies are materialized only transiently inside the round while
+local SGD diverges peers. When a round is a *single* plain-SGD step per
+trainer (no momentum, no attack, no BRB commitments needed), FedAvg-on-deltas
+is algebraically one pooled-minibatch gradient step —
+``mean_t(-lr·g_t) = -lr·∇ mean_t(loss_t)`` — so the round compiles to one
+big batched forward/backward on the MXU with a single ``psum``, never
+materializing per-peer deltas at all (the ``_fast_sync_body`` path; exactness
+is asserted by ``tests/test_round.py::test_fast_path_matches_general``).
+
 Deliberate semantic deviations from the reference, all documented:
 shared initial params (vs. unaligned per-node inits, reference ``main.py:25``),
 deterministic global sync (vs. last-writer-wins), and a held-out eval split
@@ -40,7 +52,13 @@ from p2pdl_tpu.ops.attacks import apply_attack
 from p2pdl_tpu.ops.gossip import ring_mix
 from p2pdl_tpu.ops.secure_agg import apply_masks
 from p2pdl_tpu.parallel.mesh import PEER_AXIS, peers_per_device
-from p2pdl_tpu.parallel.peer_state import PeerState, build_model, make_optimizer
+from p2pdl_tpu.parallel.peer_state import (
+    PeerState,
+    build_model,
+    global_params,
+    make_optimizer,
+    params_layout,
+)
 
 
 def make_forward_fn(model: Any, compute_dtype: jnp.dtype) -> Callable:
@@ -82,18 +100,27 @@ def make_local_train(cfg: Config, model: Any, opt: optax.GradientTransformation)
     s = cfg.samples_per_peer
     nb = cfg.batches_per_epoch
     b = cfg.batch_size
+    # With exactly one full-shard batch per epoch, the shuffle only permutes
+    # rows *within* the batch — the mean gradient is permutation-invariant —
+    # so the gather (a full copy of x per step) is skipped.
+    shuffle = not (nb == 1 and nb * b == s)
 
     def local_train(params, opt_state, key, x, y):
         def epoch(carry, ekey):
-            def batch_step(carry, bidx):
+            def batch_step(carry, batch):
                 params, opt_state = carry
-                loss, grads = grad_fn(params, x[bidx], y[bidx])
+                xb, yb = batch
+                loss, grads = grad_fn(params, xb, yb)
                 updates, opt_state = opt.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 return (params, opt_state), loss
 
-            perm = jax.random.permutation(ekey, s)[: nb * b].reshape(nb, b)
-            carry, losses = lax.scan(batch_step, carry, perm)
+            if shuffle:
+                perm = jax.random.permutation(ekey, s)[: nb * b].reshape(nb, b)
+                batches = (x[perm], y[perm])
+            else:
+                batches = (x[None], y[None])
+            carry, losses = lax.scan(batch_step, carry, batches)
             return carry, jnp.mean(losses)
 
         keys = jax.random.split(key, cfg.local_epochs)
@@ -116,6 +143,39 @@ def _aggregate(cfg: Config, deltas_trainers: Any) -> Any:
     raise ValueError(f"no gathered-reducer for {cfg.aggregator!r}")
 
 
+def _fingerprint(cfg: Config, delta: Any, l_per_dev: int) -> jnp.ndarray:
+    """Per-peer per-leaf squared delta norms: an on-device commitment the
+    host trust plane signs/BRB-broadcasts without ever transferring the
+    update itself (32 bytes of digest per peer vs the reference pickling
+    ~2 MB of weights per message, SURVEY §3.5). Computed only when the trust
+    plane is on — it is an extra full pass over the deltas."""
+    if not cfg.brb_enabled:
+        return jnp.zeros((l_per_dev, 1), jnp.float32)
+    return jnp.stack(
+        [
+            jnp.sum(l.astype(jnp.float32) ** 2, axis=tuple(range(1, l.ndim)))
+            for l in jax.tree.leaves(delta)
+        ],
+        axis=1,
+    )  # [L, n_leaves]
+
+
+def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
+    """The pooled-gradient round is exact iff local training is one plain-SGD
+    step (delta = -lr·grad, linear in the gradient), nothing perturbs
+    per-peer deltas (no attack, no per-peer masking semantics to simulate),
+    and nothing downstream needs them (no BRB fingerprints)."""
+    return (
+        cfg.aggregator == "fedavg"
+        and attack == "none"
+        and not cfg.brb_enabled
+        and cfg.momentum == 0.0
+        and cfg.local_epochs == 1
+        and cfg.batches_per_epoch == 1
+        and cfg.samples_per_peer == cfg.batch_size
+    )
+
+
 def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
     """Compile the round: ``(state, x, y, trainer_idx, byz_gate, mask_key) ->
     (state', metrics)``.
@@ -124,12 +184,65 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
     host round driver samples roles, mirroring reference ``main.py:52-54``).
     ``byz_gate``: ``[P]`` 1.0 for adversarial peers. ``mask_key``: PRNG key
     for secure-aggregation masks / noise attacks.
+
+    The input ``state`` is donated: the round overwrites it in place, so the
+    caller must use the returned state (all call sites thread it through).
     """
     model = build_model(cfg)
     opt = make_optimizer(cfg)
     l_per_dev = peers_per_device(cfg.num_peers, mesh)
-    local_train = make_local_train(cfg, model, opt)
     t = cfg.trainers_per_round
+
+    if params_layout(cfg) == "peer":
+        body = _gossip_body(cfg, mesh, attack, model, opt, l_per_dev)
+        params_spec = P(PEER_AXIS)
+    elif _use_fast_sync_path(cfg, attack):
+        body = _fast_sync_body(cfg, model, l_per_dev, t)
+        params_spec = P()
+    else:
+        body = _general_sync_body(cfg, attack, model, opt, l_per_dev, t)
+        params_spec = P()
+
+    sp = P(PEER_AXIS)
+    sr = P()
+    smapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(params_spec, sp, sp, sp, sp, sr, sr, sr, sr),
+        out_specs=(params_spec, sp, sp, sp),
+    )
+
+    def round_fn(state: PeerState, x, y, trainer_idx, byz_gate, mask_key):
+        new_params, new_opt, losses, fingerprint = smapped(
+            state.params,
+            state.opt_state,
+            state.rng,
+            x,
+            y,
+            trainer_idx,
+            byz_gate,
+            state.round_idx,
+            mask_key,
+        )
+        new_state = PeerState(
+            params=new_params,
+            opt_state=new_opt,
+            rng=state.rng,
+            round_idx=state.round_idx + 1,
+        )
+        return new_state, {"train_loss": losses, "fingerprint": fingerprint}
+
+    # Donate the state: without it every round copies the full working set
+    # (for gossip, num_peers × model) through HBM just to preserve a buffer
+    # no caller reads again.
+    return jax.jit(round_fn, donate_argnums=(0,))
+
+
+def _gossip_body(cfg, mesh, attack, model, opt, l_per_dev):
+    """Decentralized averaging (D-PSGD): peer-stacked params; every peer
+    trains, then mixes parameters with its ring neighbors — no roles, no
+    global sync. Byzantine peers mix their corrupted params into the ring."""
+    local_train = make_local_train(cfg, model, opt)
 
     def body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
         dev = lax.axis_index(PEER_AXIS)
@@ -138,30 +251,78 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
         new_params, new_opt, losses = jax.vmap(local_train)(
             params, opt_state, round_keys, x, y
         )
-
         delta = jax.tree.map(lambda n, p: n - p, new_params, params)
         gate = byz_gate[local_ids]
         delta = apply_attack(attack, delta, gate, jax.random.fold_in(mask_key, dev))
+        fingerprint = _fingerprint(cfg, delta, l_per_dev)
+        attacked = jax.tree.map(lambda p, d: p + d, params, delta)
+        mixed = ring_mix(attacked)
+        return mixed, new_opt, losses, fingerprint
 
-        # Update fingerprint: per-peer per-leaf squared norms, an on-device
-        # commitment the host trust plane signs/BRB-broadcasts without ever
-        # transferring the update itself (32 bytes of digest per peer vs the
-        # reference pickling ~2 MB of weights per message, SURVEY §3.5).
-        fingerprint = jnp.stack(
-            [
-                jnp.sum(l.astype(jnp.float32) ** 2, axis=tuple(range(1, l.ndim)))
-                for l in jax.tree.leaves(delta)
-            ],
-            axis=1,
-        )  # [L, n_leaves]
+    return body
 
-        if cfg.aggregator == "gossip":
-            # Decentralized averaging (D-PSGD): every peer trains, then mixes
-            # parameters with its ring neighbors — no roles, no global sync.
-            # Byzantine peers mix their corrupted params into the ring.
-            attacked = jax.tree.map(lambda p, d: p + d, params, delta)
-            mixed = ring_mix(attacked)
-            return mixed, new_opt, losses, fingerprint
+
+def _fast_sync_body(cfg, model, l_per_dev, t):
+    """Single-local-step plain-SGD FedAvg as one pooled gradient step.
+
+    ``mean over trainers of (-lr·∇loss_peer) = -lr·∇(mean over trainers of
+    loss_peer)``, and the server update ``p += server_lr·mean(delta)``
+    becomes ``p -= server_lr·lr·∇(pooled loss)``: one batched
+    forward/backward over every trainer's full shard with a single ``psum``
+    of gradients — arithmetic intensity ∝ total pooled batch instead of one
+    peer's batch, and no ``[P, ...]`` delta materialization."""
+    loss_fn = make_loss_fn(model, jnp.dtype(cfg.compute_dtype))
+
+    def body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
+        dev = lax.axis_index(PEER_AXIS)
+        local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
+        gate = jnp.isin(local_ids, trainer_idx).astype(jnp.float32)
+
+        def pooled_loss(p):
+            losses = jax.vmap(lambda xp, yp: loss_fn(p, xp, yp))(x, y)  # [L]
+            return jnp.sum(losses * gate) / t, losses
+
+        # pvary: differentiate w.r.t. a device-VARYING view of the replicated
+        # params. Grad of a varying loss w.r.t. an invariant value would make
+        # JAX insert an implicit psum in the backward pass (the transpose of
+        # the replicated->varying broadcast), and the explicit psum below
+        # would then double-count by the device count.
+        grads, losses = jax.grad(pooled_loss, has_aux=True)(
+            jax.lax.pcast(params, PEER_AXIS, to="varying")
+        )
+        grads = jax.tree.map(lambda g: lax.psum(g, PEER_AXIS), grads)
+        new_p = jax.tree.map(
+            lambda p, g: p - (cfg.server_lr * cfg.lr) * g.astype(p.dtype), params, grads
+        )
+        return new_p, opt_state, losses, _fingerprint(cfg, None, l_per_dev)
+
+    return body
+
+
+def _general_sync_body(cfg, attack, model, opt, l_per_dev, t):
+    """Role-based round over single-copy global params: broadcast the global
+    model into a vmapped local-SGD phase (peers diverge only transiently),
+    aggregate trainer deltas, apply one deterministic server update."""
+    local_train = make_local_train(cfg, model, opt)
+
+    def body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
+        dev = lax.axis_index(PEER_AXIS)
+        local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
+        round_keys = jax.vmap(lambda k: jax.random.fold_in(k, round_idx))(rng)
+        # pvary: local SGD must differentiate w.r.t. a device-VARYING view of
+        # the replicated global params — grad w.r.t. an invariant value under
+        # shard_map gets an implicit psum inserted (transpose of the
+        # replicated->varying broadcast), which would silently turn per-peer
+        # local gradients into the global sum.
+        pvaried = jax.lax.pcast(params, PEER_AXIS, to="varying")
+        new_params, new_opt, losses = jax.vmap(
+            local_train, in_axes=(None, 0, 0, 0, 0)
+        )(pvaried, opt_state, round_keys, x, y)
+
+        delta = jax.tree.map(lambda n, p: n - p[None], new_params, pvaried)
+        gate = byz_gate[local_ids]
+        delta = apply_attack(attack, delta, gate, jax.random.fold_in(mask_key, dev))
+        fingerprint = _fingerprint(cfg, delta, l_per_dev)
 
         is_trainer = jnp.isin(local_ids, trainer_idx)
 
@@ -183,53 +344,36 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
                 lambda d: lax.all_gather(d, PEER_AXIS, axis=0, tiled=True), delta
             )
             agg = _aggregate(cfg, jax.tree.map(lambda d: d[trainer_idx], all_d))
+            # The reducer's result is bitwise identical on every device, but
+            # the vma type system can't infer that through argsort/gather —
+            # materialize it as replicated by psum-selecting device 0's copy.
+            agg = jax.tree.map(
+                lambda a: lax.psum(jnp.where(dev == 0, a, jnp.zeros_like(a)), PEER_AXIS),
+                agg,
+            )
 
         # Server update (reference applies 0.1 * avg_delta in place,
         # ``aggregator/aggregation.py:36-38``); peers stay in lockstep.
-        # Optimizer state (momentum, if enabled) deliberately carries across
-        # rounds per peer even though params re-sync — the reference likewise
-        # constructs each node's SGD once and keeps it for the experiment's
-        # lifetime (``node/node.py:30``).
         new_p = jax.tree.map(
             lambda p, a: p + cfg.server_lr * a.astype(p.dtype), params, agg
         )
+
+        # Only this round's trainers actually trained in the reference
+        # (non-trainers idle, ``main.py:72-80``): their optimizer state
+        # (momentum, if enabled) must not advance. The optimizer is per-peer
+        # for the experiment's lifetime (reference ``node/node.py:30``).
+        def keep_trainers(n, o):
+            m = is_trainer.reshape((l_per_dev,) + (1,) * (n.ndim - 1))
+            return jnp.where(m, n, o)
+
+        new_opt = jax.tree.map(keep_trainers, new_opt, opt_state)
         return new_p, new_opt, losses, fingerprint
 
-    sp = P(PEER_AXIS)
-    sr = P()
-    smapped = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(sp, sp, sp, sp, sp, sr, sr, sr, sr),
-        out_specs=(sp, sp, sp, sp),
-    )
-
-    @jax.jit
-    def round_fn(state: PeerState, x, y, trainer_idx, byz_gate, mask_key):
-        new_params, new_opt, losses, fingerprint = smapped(
-            state.params,
-            state.opt_state,
-            state.rng,
-            x,
-            y,
-            trainer_idx,
-            byz_gate,
-            state.round_idx,
-            mask_key,
-        )
-        new_state = PeerState(
-            params=new_params,
-            opt_state=new_opt,
-            rng=state.rng,
-            round_idx=state.round_idx + 1,
-        )
-        return new_state, {"train_loss": losses, "fingerprint": fingerprint}
-
-    return round_fn
+    return body
 
 
 def build_eval_fn(cfg: Config) -> Callable:
-    """Held-out evaluation of the synchronized global model (peer 0's slice).
+    """Held-out evaluation of the synchronized global model.
 
     Replaces reference ``evaluation/evaluation.py:4-24``, which evaluates on
     each node's *training* shard — here eval runs on data no peer trained on.
@@ -239,8 +383,7 @@ def build_eval_fn(cfg: Config) -> Callable:
 
     @jax.jit
     def eval_fn(state: PeerState, eval_x, eval_y):
-        params = jax.tree.map(lambda l: l[0], state.params)
-        logits = forward(params, eval_x)
+        logits = forward(global_params(state, cfg), eval_x)
         loss = optax.softmax_cross_entropy_with_integer_labels(logits, eval_y).mean()
         acc = jnp.mean(jnp.argmax(logits, axis=-1) == eval_y)
         return {"eval_loss": loss, "eval_acc": acc}

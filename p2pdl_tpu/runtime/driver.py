@@ -35,6 +35,7 @@ from p2pdl_tpu.parallel import (
     init_peer_state,
     make_mesh,
     peer_sharding,
+    shard_state,
 )
 from p2pdl_tpu.protocol.brb import BRBConfig, Broadcaster
 from p2pdl_tpu.protocol.crypto import KeyServer, generate_key_pair
@@ -187,9 +188,7 @@ class Experiment:
             state = init_peer_state(cfg)
 
         sh = peer_sharding(self.mesh)
-        self.state = jax.tree.map(
-            lambda l: jax.device_put(l, sh) if getattr(l, "ndim", 0) >= 1 else l, state
-        )
+        self.state = shard_state(state, cfg, self.mesh)
         self.x = jax.device_put(self.data.x, sh)
         self.y = jax.device_put(self.data.y, sh)
         byz_gate = np.zeros(cfg.num_peers, np.float32)
@@ -224,7 +223,14 @@ class Experiment:
                 self.byz_gate,
                 jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), r),
             )
-            train_loss = float(jnp.mean(m["train_loss"]))
+            # Mean over this round's trainers only — non-trainers' local
+            # losses exist on-device but the reference's progress metric is
+            # trainer loss (``main.py:90-94`` collects from trainer runs).
+            # Gossip has no roles: every peer trains, so every loss counts.
+            losses = np.asarray(m["train_loss"])
+            if self.cfg.aggregator != "gossip":
+                losses = losses[trainers]
+            train_loss = float(np.mean(losses))
 
         brb_delivered = brb_failed = msgs = nbytes = None
         if self.trust is not None:

@@ -79,7 +79,11 @@ class Checkpointer:
             args=ocp.args.Composite(
                 state=ocp.args.StandardSave(_state_to_tree(state)),
                 config=ocp.args.JsonSave(
-                    {"config": dataclasses.asdict(cfg), "extra": extra or {}}
+                    {
+                        "config": dataclasses.asdict(cfg),
+                        "extra": extra or {},
+                        "format_version": FORMAT_VERSION,
+                    }
                 ),
             ),
         )
@@ -124,6 +128,15 @@ class Checkpointer:
         meta = self._mngr.restore(
             step, args=ocp.args.Composite(config=ocp.args.JsonRestore())
         )["config"]
+        saved_version = meta.get("format_version", 1)
+        if saved_version != FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint at {self.directory} step {step} has state-layout "
+                f"format v{saved_version}, this build reads v{FORMAT_VERSION} "
+                f"(v2 stores sync-aggregator params as one global copy, not "
+                f"peer-stacked); re-run the experiment to produce a new "
+                f"checkpoint"
+            )
         saved_cfg = Config(**meta["config"])
         diff = _config_diff(saved_cfg, cfg)
         for field in RESUME_COMPATIBLE_FIELDS:
@@ -151,6 +164,11 @@ class Checkpointer:
 # Config fields that do not shape the checkpointed state and so may change
 # across a resume (e.g. raising ``rounds`` to extend a finished experiment).
 RESUME_COMPATIBLE_FIELDS = ("rounds", "round_timeout_s", "brb_enabled")
+
+# Bumped when the PeerState pytree layout changes (v2: sync-layout params are
+# a single global copy). An identical Config can describe either layout, so
+# the config diff alone cannot catch a stale checkpoint — the version can.
+FORMAT_VERSION = 2
 
 
 def _config_diff(a: Config, b: Config) -> dict[str, tuple[Any, Any]]:

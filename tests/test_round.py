@@ -15,15 +15,14 @@ from p2pdl_tpu.parallel import (
     init_peer_state,
     make_mesh,
     peer_sharding,
+    shard_state,
 )
 
 
-def _put(state, data, mesh):
-    """Shard peer-stacked arrays over the mesh."""
+def _put(state, data, cfg, mesh):
+    """Place state (layout-aware) and peer-sharded data on the mesh."""
     sh = peer_sharding(mesh)
-    state = jax.tree.map(
-        lambda l: jax.device_put(l, sh) if getattr(l, "ndim", 0) >= 1 else l, state
-    )
+    state = shard_state(state, cfg, mesh)
     x = jax.device_put(data.x, sh)
     y = jax.device_put(data.y, sh)
     return state, x, y
@@ -32,7 +31,7 @@ def _put(state, data, mesh):
 def _run_rounds(cfg, mesh, n_rounds, attack="none", byz_ids=()):
     data = make_federated_data(cfg, eval_samples=256)
     state = init_peer_state(cfg)
-    state, x, y = _put(state, data, mesh)
+    state, x, y = _put(state, data, cfg, mesh)
     round_fn = build_round_fn(cfg, mesh, attack=attack)
     eval_fn = build_eval_fn(cfg)
 
@@ -78,11 +77,49 @@ def test_fedavg_learns(base_cfg, mesh8):
     assert ev["eval_acc"] > 0.5, f"eval acc too low: {ev}"
 
 
-def test_peers_stay_synchronized(base_cfg, mesh8):
+def test_sync_layout_stores_params_once(base_cfg, mesh8):
+    """Peers are provably synchronized under role-based aggregation, so the
+    global model is stored once: param leaves carry NO peer dimension."""
     state, _, _ = _run_rounds(base_cfg, mesh8, n_rounds=2)
-    for leaf in jax.tree.leaves(state.params):
-        leaf = np.asarray(leaf)
-        assert np.allclose(leaf, leaf[0:1], atol=1e-5), "peer params diverged under fedavg"
+    ref = init_peer_state(base_cfg)
+    for got, want in zip(jax.tree.leaves(state.params), jax.tree.leaves(ref.params)):
+        assert got.shape == want.shape
+
+
+def test_fast_path_matches_general(mesh8):
+    """Single-local-step plain-SGD FedAvg compiles to the pooled-gradient
+    fast path; its result must be numerically the general path's. The
+    general path is forced with attack='noise' + an all-zero Byzantine gate
+    (the gate makes the attack an exact no-op)."""
+    cfg = Config(
+        num_peers=8,
+        trainers_per_round=6,
+        local_epochs=1,
+        samples_per_peer=32,
+        batch_size=32,
+        lr=0.05,
+        server_lr=0.7,
+        dataset="mnist",
+        model="mlp",
+        # float32 compute isolates the algebraic equivalence from bfloat16
+        # backward-pass rounding (which reorders accumulation between the
+        # pooled and per-peer formulations).
+        compute_dtype="float32",
+    )
+    data = make_federated_data(cfg, eval_samples=64)
+    trainer_idx = jnp.asarray([0, 2, 3, 5, 6, 7], jnp.int32)
+    byz = jnp.zeros(cfg.num_peers)
+    results = []
+    for attack in ("none", "noise"):
+        state = init_peer_state(cfg)
+        state, x, y = _put(state, data, cfg, mesh8)
+        fn = build_round_fn(cfg, mesh8, attack=attack)
+        state, m = fn(state, x, y, trainer_idx, byz, jax.random.PRNGKey(0))
+        results.append((state.params, m["train_loss"]))
+    (p_fast, l_fast), (p_gen, l_gen) = results
+    for a, b in zip(jax.tree.leaves(p_fast), jax.tree.leaves(p_gen)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(l_fast), np.asarray(l_gen), atol=1e-5)
 
 
 def test_round_idx_advances(base_cfg, mesh8):
