@@ -3,20 +3,28 @@
 The reference has no attention at all (its zoo is MLP+CNN, reference
 ``models/model.py``); our transformer family (ViT, and any long-sequence
 model) needs attention that does not materialize the ``[T, T]`` score matrix
-in HBM. XLA's dot-softmax-dot emission is already decent at small T, but the
-fused kernel keeps the whole online-softmax recurrence in VMEM: one pass over
-key blocks per query block, accumulators in float32, logits never leaving
-the chip — the flash-attention scheme (Dao et al. 2022) expressed the Pallas
-way (grid over [batch*heads, query blocks], ``fori_loop`` over key blocks).
+in HBM. The fused kernel keeps the online-softmax recurrence in VMEM:
+accumulators in float32, logits never leaving the chip — the flash-attention
+scheme (Dao et al. 2022) expressed the Pallas way.
 
-The backward pass is two more Pallas kernels (dk/dv gridded over key blocks,
-dq over query blocks) using the stored logsumexp — standard flash backward:
-``ds = p * (dp - rowsum(do*o))``. Everything is wrapped in ``jax.custom_vjp``
-so ``flash_attention`` drops into any ``jax.grad`` training step.
+Kernel structure: a 3-D grid ``(batch*heads, query blocks, key blocks)``
+(outer two parallel, innermost sequential), with the running ``(o, m, l)``
+accumulators living in VMEM scratch that persists across the innermost grid
+dimension. Both operands are therefore streamed block-by-block by the Pallas
+pipeline — VMEM use is O(block_q·d + block_k·d), independent of sequence
+length, so the kernel serves exactly the long-sequence regime it exists for
+(a full-T BlockSpec would cap T at a few thousand). Fully-masked key blocks
+of causal attention are skipped via ``pl.when``.
 
-On non-TPU backends the same kernels run in Pallas interpret mode (tests
-compare them bitwise-ish against the dense reference in
-``p2pdl_tpu.ops.attention.sdpa``).
+The backward pass is two more Pallas kernels of the same shape (dk/dv
+gridded over key blocks with query blocks innermost, dq the transpose) using
+the stored logsumexp — standard flash backward: ``ds = p*(dp - rowsum(do*o))``.
+Everything is wrapped in ``jax.custom_vjp`` so ``flash_attention`` drops into
+any ``jax.grad`` training step.
+
+Off-TPU, auto mode routes to the dense JAX path (see ``flash_attention``);
+kernel math is CPU-tested by forcing Pallas interpret mode explicitly
+(tests compare it against the dense reference ``p2pdl_tpu.ops.attention.sdpa``).
 """
 
 from __future__ import annotations
@@ -26,109 +34,119 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float("-inf")
+# Scalar-per-row accumulators (m, l) are stored broadcast across one lane
+# register of width 128 — Mosaic's native vector layout for row statistics.
+_LANES = 128
 
 
-def _auto_interpret() -> bool:
-    """Compile on any TPU device, interpret elsewhere (CPU tests).
-
-    Keyed on the device, not the backend *name*: TPU PJRT plugins can be
-    registered under a different platform name (this image's tunnel registers
-    the TPU as platform "axon"), and interpret mode there would silently run
-    the kernels in the Python-level Pallas interpreter on real hardware.
-    """
+def _on_tpu() -> bool:
+    """True on any TPU device — keyed on the device, not the backend *name*:
+    TPU PJRT plugins can be registered under a different platform name (this
+    image's tunnel registers the TPU as platform "axon"), and interpret mode
+    there would silently run the kernels in the Python-level Pallas
+    interpreter on real hardware."""
     dev = jax.devices()[0]
-    return not ("tpu" in dev.platform.lower() or "tpu" in dev.device_kind.lower())
+    return "tpu" in dev.platform.lower() or "tpu" in dev.device_kind.lower()
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k, t_real, off
+    q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc, l_acc,
+    *, scale, causal, t_real, off,
 ):
-    """One query block against all key blocks. Refs: q [1, bq, D];
-    k, v [1, Tk, D]; o [1, bq, D]; lse [1, bq]. ``off = t_k - t_q`` aligns
-    causal positions for rectangular attention (sdpa's convention: query i
-    attends keys j <= i + off)."""
-    iq = pl.program_id(1)
+    """Grid (bh, nq, nk), innermost sequential over key blocks.
+
+    Refs: q/o [1, bq, D]; k/v [1, bk, D]; lse [1, bq]; scratch o_acc [bq, D],
+    m/l_acc [bq, LANES] (row stats broadcast over lanes). ``off = Tk - Tq``
+    aligns causal positions for rectangular attention (sdpa's convention:
+    query i attends keys j <= i + off)."""
+    iq, jk = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
     bq = q_ref.shape[1]
-    t_pad = k_ref.shape[1]
-    d = q_ref.shape[2]
-    nk = t_pad // block_k
+    bk = k_ref.shape[1]
 
-    q = q_ref[0].astype(jnp.float32) * scale  # [bq, D]
-    q_pos = iq * bq + off + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    @pl.when(jk == 0)
+    def _():
+        o_acc[:] = jnp.zeros_like(o_acc)
+        m_acc[:] = jnp.full_like(m_acc, NEG_INF)
+        l_acc[:] = jnp.zeros_like(l_acc)
 
-    if causal:
-        # Key blocks strictly after this query block's last allowed key are
-        # fully masked — skip them entirely.
-        nk_eff = jnp.clip(
-            jax.lax.div((iq + 1) * bq + off + block_k - 1, block_k), 0, nk
-        )
-    else:
-        nk_eff = nk
-
-    def body(jk, carry):
-        o_acc, m, l = carry
-        k_blk = k_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # [bq, D]
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bk]
-        k_pos = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        q_pos = iq * bq + off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = k_pos < t_real
         if causal:
             mask = jnp.logical_and(mask, q_pos >= k_pos)
         s = jnp.where(mask, s, NEG_INF)
 
+        m = m_acc[:, 0]
+        l = l_acc[:, 0]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.where(mask, jnp.exp(s - safe_m[:, None]), 0.0)
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
         l_new = l * corr + jnp.sum(p, axis=-1)
-        o_new = o_acc * corr[:, None] + jax.lax.dot_general(
+        o_acc[:] = o_acc[:] * corr[:, None] + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return o_new, m_new, l_new
+        m_acc[:] = jnp.broadcast_to(m_new[:, None], m_acc.shape)
+        l_acc[:] = jnp.broadcast_to(l_new[:, None], l_acc.shape)
 
-    o0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    o_acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (o0, m0, l0))
+    if causal:
+        # Key blocks strictly after this query block's last allowed key are
+        # fully masked — skip their compute (operand streaming still occurs).
+        pl.when(jk * bk <= (iq + 1) * bq - 1 + off)(compute)
+    else:
+        compute()
 
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (o_acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse = jnp.where(jnp.isfinite(m), m + jnp.log(l_safe), NEG_INF)
-    lse_ref[0] = lse
+    @pl.when(jk == nk - 1)
+    def _():
+        m = m_acc[:, 0]
+        l = l_acc[:, 0]
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0] = (o_acc[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(jnp.isfinite(m), m + jnp.log(l_safe), NEG_INF)
 
 
 def _dkdv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, scale, causal, block_q, t_real, off,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+    *, scale, causal, t_real, off,
 ):
-    """One key block against all query blocks. k/v/dk/dv [1, bk, D];
-    q/do [1, Tq, D]; lse/delta [1, Tq]."""
-    jk = pl.program_id(1)
+    """Grid (bh, nk, nq), innermost sequential over query blocks.
+
+    k/v/dk/dv [1, bk, D]; q/do [1, bq, D]; lse/delta [1, bq]; scratch
+    dk/dv_acc [bk, D] float32."""
+    jk, iq = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
     bk = k_ref.shape[1]
-    t_pad = q_ref.shape[1]
-    nq = t_pad // block_q
+    bq = q_ref.shape[1]
 
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    k_pos = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    @pl.when(iq == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    start_q = jnp.clip(jax.lax.div(jk * bk - off, block_q), 0, nq) if causal else 0
-
-    def body(iq, carry):
-        dk_acc, dv_acc = carry
-        q_blk = q_ref[0, pl.ds(iq * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(iq * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(iq * block_q, block_q)]
-        delta_blk = delta_ref[0, pl.ds(iq * block_q, block_q)]
+    def compute():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q_blk = q_ref[0].astype(jnp.float32)
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse_blk = lse_ref[0]
+        delta_blk = delta_ref[0]
 
         s = scale * jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bk]
-        q_pos = iq * block_q + off + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+        q_pos = iq * bq + off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = k_pos < t_real
         if causal:
             mask = jnp.logical_and(mask, q_pos >= k_pos)
@@ -139,51 +157,52 @@ def _dkdv_kernel(
             do_blk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta_blk[:, None])  # [bq, bk]
-        dk_new = dk_acc + scale * jax.lax.dot_general(
+        dk_acc[:] += scale * jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bk, D]
-        dv_new = dv_acc + jax.lax.dot_general(
+        dv_acc[:] += jax.lax.dot_general(
             p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return dk_new, dv_new
 
-    dk0 = jnp.zeros(dk_ref.shape[1:], jnp.float32)
-    dv0 = jnp.zeros(dv_ref.shape[1:], jnp.float32)
-    dk, dv = jax.lax.fori_loop(start_q, nq, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if causal:
+        # Query blocks that end before this key block starts can't attend it.
+        pl.when(iq * bq + bq - 1 + off >= jk * bk)(compute)
+    else:
+        compute()
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, scale, causal, block_k, t_real, off,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, scale, causal, t_real, off,
 ):
-    """One query block against all key blocks, accumulating dq."""
-    iq = pl.program_id(1)
+    """Grid (bh, nq, nk), innermost sequential over key blocks, accumulating
+    dq for one query block in scratch [bq, D]."""
+    iq, jk = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
     bq = q_ref.shape[1]
-    t_pad = k_ref.shape[1]
-    nk = t_pad // block_k
+    bk = k_ref.shape[1]
 
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
-    q_pos = iq * bq + off + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    @pl.when(jk == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    if causal:
-        nk_eff = jnp.clip(
-            jax.lax.div((iq + 1) * bq + off + block_k - 1, block_k), 0, nk
-        )
-    else:
-        nk_eff = nk
-
-    def body(jk, dq_acc):
-        k_blk = k_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = scale * jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        k_pos = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        q_pos = iq * bq + off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = k_pos < t_real
         if causal:
             mask = jnp.logical_and(mask, q_pos >= k_pos)
@@ -193,12 +212,30 @@ def _dq_kernel(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta[:, None])
-        return dq_acc + scale * jax.lax.dot_general(
+        dq_acc[:] += scale * jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    dq = jax.lax.fori_loop(0, nk_eff, body, jnp.zeros(dq_ref.shape[1:], jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    if causal:
+        pl.when(jk * bk <= (iq + 1) * bq - 1 + off)(compute)
+    else:
+        compute()
+
+    @pl.when(jk == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _vma(x) -> frozenset:
+    """Varying-manual-axes of ``x`` (non-empty only under ``shard_map``).
+
+    ``pallas_call`` output avals must carry the same vma as the operands when
+    the kernel runs inside ``shard_map`` with vma checking on; outside, this
+    is the empty set and has no effect."""
+    try:
+        return frozenset(jax.typeof(x).vma)
+    except Exception:  # non-traced input or backend without vma support
+        return frozenset()
 
 
 def _pad_t(x: jnp.ndarray, block: int) -> jnp.ndarray:
@@ -207,6 +244,11 @@ def _pad_t(x: jnp.ndarray, block: int) -> jnp.ndarray:
     if pad == 0:
         return x
     return jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+
+
+_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary")
+)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -228,27 +270,32 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     block_k = min(block_k, tk)
     qp, kp, vp = _pad_t(q, block_q), _pad_t(k, block_k), _pad_t(v, block_k)
     tq_pad, tk_pad = qp.shape[1], kp.shape[1]
-    nq = tq_pad // block_q
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_k=block_k, t_real=tk, off=off
+        _fwd_kernel, scale=scale, causal=causal, t_real=tk, off=off
     )
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, nq),
+        grid=(bh, tq_pad // block_q, tk_pad // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, tk_pad, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tk_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tq_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq_pad), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq_pad, d), q.dtype, vma=_vma(q)),
+            jax.ShapeDtypeStruct((bh, tq_pad), jnp.float32, vma=_vma(q)),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=_SEMANTICS,
         interpret=interpret,
     )(qp, kp, vp)
     return out[:, :tq], lse[:, :tq]
@@ -275,51 +322,57 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     kp, vp = _pad_t(k, block_k), _pad_t(v, block_k)
     tq_pad, tk_pad = qp.shape[1], kp.shape[1]
     pad_q = tq_pad - tq
-    # Padded rows must not contribute: lse=-inf makes their p rows zero.
+    # Padded q rows: lse=-inf gives well-defined (finite) p rows, and their
+    # do rows are zero, so they contribute nothing to dk/dv.
     lse_p = jnp.pad(lse, ((0, 0), (0, pad_q)), constant_values=NEG_INF)
     delta_p = jnp.pad(delta, ((0, 0), (0, pad_q)))
 
     dkdv = functools.partial(
-        _dkdv_kernel, scale=scale, causal=causal, block_q=block_q, t_real=tk, off=off
+        _dkdv_kernel, scale=scale, causal=causal, t_real=tk, off=off
     )
     dk, dv = pl.pallas_call(
         dkdv,
-        grid=(bh, tk_pad // block_k),
+        grid=(bh, tk_pad // block_k, tq_pad // block_q),
         in_specs=[
-            pl.BlockSpec((1, tq_pad, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, tq_pad, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, tq_pad), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, tq_pad), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tk_pad, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, tk_pad, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, tk_pad, d), k.dtype, vma=_vma(k)),
+            jax.ShapeDtypeStruct((bh, tk_pad, d), v.dtype, vma=_vma(v)),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=_SEMANTICS,
         interpret=interpret,
     )(qp, kp, vp, dop, lse_p, delta_p)
 
-    dqk = functools.partial(
-        _dq_kernel, scale=scale, causal=causal, block_k=block_k, t_real=tk, off=off
-    )
+    dqk = functools.partial(_dq_kernel, scale=scale, causal=causal, t_real=tk, off=off)
     dq = pl.pallas_call(
         dqk,
-        grid=(bh, tq_pad // block_q),
+        grid=(bh, tq_pad // block_q, tk_pad // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, tk_pad, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tk_pad, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq_pad, d), q.dtype),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq_pad, d), q.dtype, vma=_vma(q)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_SEMANTICS,
         interpret=interpret,
     )(qp, kp, vp, dop, lse_p, delta_p)
 
@@ -336,15 +389,25 @@ def flash_attention(
     causal: bool = False,
     block_q: int = 128,
     block_k: int = 128,
-    interpret: bool | None = None,
+    interpret=None,
 ) -> jnp.ndarray:
     """Fused attention over ``[B, H, T, D]`` (same contract as ``sdpa``).
 
-    ``interpret=None`` auto-selects Pallas interpret mode off-TPU so the one
-    code path runs everywhere; on TPU the kernels compile via Mosaic.
+    ``interpret=None`` auto-selects: Mosaic-compiled kernels on TPU, the
+    dense JAX path (``sdpa``, numerically the same attention) elsewhere.
+    The off-TPU default is dense rather than Pallas-interpret because the
+    two interpreters have complementary composition bugs in current JAX
+    (generic ``interpret=True`` breaks under ``shard_map`` vma typing;
+    ``pltpu.InterpretParams`` breaks under ``vmap``), and the peer-mesh
+    round wraps models in both. Kernel *math* is still CPU-tested by
+    passing ``interpret`` explicitly (tests/test_pallas_attention.py).
     """
     if interpret is None:
-        interpret = _auto_interpret()
+        if not _on_tpu():
+            from p2pdl_tpu.ops.attention import sdpa
+
+            return sdpa(q, k, v, causal=causal)
+        interpret = False
     b, h, t, d = q.shape
     flat = lambda x: x.reshape(b * h, x.shape[2], x.shape[-1])
     out = _flash(flat(q), flat(k), flat(v), causal, block_q, block_k, interpret)
