@@ -1,4 +1,4 @@
-"""Benchmark: aggregation rounds/sec with 1024 simulated peers.
+"""Benchmarks: aggregation rounds/sec across the BASELINE.md config matrix.
 
 The BASELINE.json metric ("aggregation rounds/sec at N={8,128,1024} peers";
 north star >= 50 rounds/sec at 1024 peers). The reference publishes no
@@ -6,22 +6,26 @@ numbers (reference ``README.md`` has none; ``BASELINE.json`` records
 ``"published": {}``), so ``vs_baseline`` is reported against the north-star
 target of 50 rounds/sec.
 
-One round = every peer runs a full local-SGD pass on its shard (1 epoch over
-32 samples, batch 32) + delta computation + masked-psum FedAvg + global
-sync — the complete data-plane work of the reference's
-train/exchange/aggregate/broadcast cycle (reference ``main.py:50-84``),
-executing as one compiled program.
+One round = every sampled trainer runs a full local-SGD pass on its shard +
+delta computation + aggregation + global sync — the complete data-plane work
+of the reference's train/exchange/aggregate/broadcast cycle (reference
+``main.py:50-84``), executing as one compiled program.
 
-Prints exactly one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Default invocation (the driver contract) prints exactly ONE JSON line for
+the headline config: {"metric", "value", "unit", "vs_baseline"}.
+``python bench.py --matrix`` additionally runs the full BASELINE.md matrix,
+printing one JSON line per config and writing ``BENCH_MATRIX.json``.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from p2pdl_tpu.config import Config
 from p2pdl_tpu.data import make_federated_data
@@ -36,16 +40,13 @@ from p2pdl_tpu.parallel import (
 NORTH_STAR_ROUNDS_PER_SEC = 50.0
 
 
-def bench_rounds_per_sec(num_peers: int = 1024, timed_rounds: int = 20) -> float:
-    cfg = Config(
-        num_peers=num_peers,
-        trainers_per_round=num_peers,
-        local_epochs=1,
-        samples_per_peer=32,
-        batch_size=32,
-        model="mlp",
-        dataset="mnist",
-    )
+def bench_config(
+    cfg: Config,
+    attack: str = "none",
+    byz_ids: tuple[int, ...] = (),
+    timed_rounds: int = 20,
+) -> float:
+    """Rounds/sec of the compiled federated round for one config."""
     mesh = make_mesh()
     data = make_federated_data(cfg, eval_samples=16)
     state = shard_state(init_peer_state(cfg), cfg, mesh)
@@ -53,9 +54,16 @@ def bench_rounds_per_sec(num_peers: int = 1024, timed_rounds: int = 20) -> float
     x = jax.device_put(data.x, sh)
     y = jax.device_put(data.y, sh)
 
-    round_fn = build_round_fn(cfg, mesh)
-    trainer_idx = jnp.arange(cfg.trainers_per_round, dtype=jnp.int32)
-    byz = jnp.zeros(cfg.num_peers)
+    round_fn = build_round_fn(cfg, mesh, attack=attack)
+    rng = np.random.default_rng(cfg.seed)
+    trainer_idx = jnp.asarray(
+        np.sort(rng.choice(cfg.num_peers, cfg.trainers_per_round, replace=False)),
+        jnp.int32,
+    )
+    byz = np.zeros(cfg.num_peers, np.float32)
+    for i in byz_ids:
+        byz[i] = 1.0
+    byz = jnp.asarray(byz)
     key = jax.random.PRNGKey(0)
 
     # Warmup / compile.
@@ -70,7 +78,94 @@ def bench_rounds_per_sec(num_peers: int = 1024, timed_rounds: int = 20) -> float
     return timed_rounds / dt
 
 
+def bench_rounds_per_sec(num_peers: int = 1024, timed_rounds: int = 20) -> float:
+    """Headline metric: 1024-peer MLP FedAvg rounds/sec."""
+    return bench_config(_headline_cfg(num_peers), timed_rounds=timed_rounds)
+
+
+def _headline_cfg(num_peers: int = 1024) -> Config:
+    return Config(
+        num_peers=num_peers,
+        trainers_per_round=num_peers,
+        local_epochs=1,
+        samples_per_peer=32,
+        batch_size=32,
+        model="mlp",
+        dataset="mnist",
+    )
+
+
+def matrix_entries() -> list[dict]:
+    """The BASELINE.md config matrix (BASELINE.json "configs")."""
+    return [
+        {
+            "name": "mnist_mlp_8peers_fedavg",
+            "cfg": Config(
+                num_peers=8, trainers_per_round=3, local_epochs=5,
+                samples_per_peer=64, batch_size=32, model="mlp", dataset="mnist",
+            ),
+        },
+        {
+            "name": "cifar10_resnet18_32peers_dirichlet",
+            "cfg": Config(
+                num_peers=32, trainers_per_round=8, local_epochs=1,
+                samples_per_peer=32, batch_size=32, model="resnet18",
+                dataset="cifar10", partition="dirichlet", dirichlet_alpha=0.5,
+            ),
+        },
+        {
+            "name": "cifar10_cnn_128peers_krum_10pct_byz",
+            "cfg": Config(
+                num_peers=128, trainers_per_round=32, local_epochs=1,
+                samples_per_peer=32, batch_size=32, model="simple_cnn",
+                dataset="cifar10", aggregator="krum", byzantine_f=13,
+            ),
+            "attack": "sign_flip",
+            "byz_ids": tuple(range(0, 128, 10)),  # ~10% adversarial
+        },
+        {
+            "name": "shakespeare_lstm_256peers_gossip",
+            "cfg": Config(
+                num_peers=256, trainers_per_round=256, local_epochs=1,
+                samples_per_peer=32, batch_size=32, model="char_lstm",
+                dataset="shakespeare", aggregator="gossip", seq_len=64,
+            ),
+        },
+        {
+            "name": "vit_tiny_1024peers_secure_fedavg",
+            "cfg": Config(
+                num_peers=1024, trainers_per_round=1024, local_epochs=1,
+                samples_per_peer=8, batch_size=8, model="vit_tiny",
+                dataset="cifar10", aggregator="secure_fedavg",
+            ),
+        },
+    ]
+
+
+def run_matrix(timed_rounds: int = 10) -> list[dict]:
+    results = []
+    for entry in matrix_entries():
+        value = bench_config(
+            entry["cfg"],
+            attack=entry.get("attack", "none"),
+            byz_ids=entry.get("byz_ids", ()),
+            timed_rounds=timed_rounds,
+        )
+        rec = {
+            "metric": f"agg_rounds_per_sec_{entry['name']}",
+            "value": round(value, 3),
+            "unit": "rounds/sec",
+        }
+        print(json.dumps(rec), flush=True)
+        results.append(rec)
+    return results
+
+
 def main() -> None:
+    if "--matrix" in sys.argv:
+        results = run_matrix()
+        with open("BENCH_MATRIX.json", "w") as f:
+            json.dump(results, f, indent=1)
     value = bench_rounds_per_sec()
     print(
         json.dumps(

@@ -58,6 +58,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", default=None, help="checkpoint/resume directory")
     p.add_argument("--checkpoint-every", type=int, default=1, help="rounds between checkpoints")
     p.add_argument("--profile-dir", default=None, help="jax.profiler trace output dir")
+    p.add_argument(
+        "--failure-cooldown",
+        type=int,
+        default=0,
+        help="rounds a BRB-failed peer is excluded from trainer sampling (0=off)",
+    )
     p.add_argument("--port", type=int, default=5000, help="HTTP port (serve mode)")
     p.add_argument("--n-devices", type=int, default=None, help="mesh size (default: all)")
     p.add_argument(
@@ -148,7 +154,7 @@ def main(argv: list[str] | None = None) -> int:
         cfg, attack=args.attack, byz_ids=byz_ids,
         log_path=args.log_path, n_devices=args.n_devices,
         checkpoint_dir=args.checkpoint_dir, checkpoint_every=args.checkpoint_every,
-        profile_dir=args.profile_dir,
+        profile_dir=args.profile_dir, failure_cooldown_rounds=args.failure_cooldown,
     )
     with exp.profiler.trace():
         while int(exp.state.round_idx) < cfg.rounds:

@@ -160,10 +160,19 @@ class Experiment:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 1,
         profile_dir: Optional[str] = None,
+        failure_cooldown_rounds: int = 0,
     ) -> None:
         self.cfg = cfg
         self.attack = attack
         self.byz_ids = tuple(byz_ids)
+        # Failure detection -> exclusion (reference has none: one silent peer
+        # stalls its round forever, reference ``node/node.py:73`` +
+        # ``utils/waiting.py``). Peers whose BRB delivery failed are excluded
+        # from trainer sampling for this many subsequent rounds, then
+        # re-admitted. Suspicion is runtime-ephemeral (a resumed experiment
+        # starts with a clean slate, like any real failure detector).
+        self.failure_cooldown_rounds = failure_cooldown_rounds
+        self._suspect_until: dict[int, int] = {}
         self.mesh = make_mesh(n_devices)
         self.data = make_federated_data(cfg)
         self.round_fn = build_round_fn(cfg, self.mesh, attack=attack)
@@ -202,13 +211,26 @@ class Experiment:
 
         Keyed by ``(seed, round_idx)`` — not by a stateful generator — so a
         resumed experiment samples the exact roles the uninterrupted run
-        would have (checkpoint/resume determinism)."""
+        would have (checkpoint/resume determinism). Exception: with
+        ``failure_cooldown_rounds`` active, the suspicion table is runtime
+        state, so a resume right after a peer failure can sample that peer
+        where the uninterrupted run would not — suspicion is observational,
+        not part of the training state."""
         if round_idx is None:
             round_idx = int(self.state.round_idx)
         rng = np.random.default_rng([self.cfg.seed, round_idx])
-        return np.sort(
-            rng.choice(self.cfg.num_peers, self.cfg.trainers_per_round, replace=False)
+        eligible = np.asarray(
+            [
+                p
+                for p in range(self.cfg.num_peers)
+                if self._suspect_until.get(p, -1) < round_idx
+            ]
         )
+        if len(eligible) < self.cfg.trainers_per_round:
+            # Too many suspects to fill the round: degrade gracefully to the
+            # full peer set rather than shrinking the trainer quorum.
+            eligible = np.arange(self.cfg.num_peers)
+        return np.sort(rng.choice(eligible, self.cfg.trainers_per_round, replace=False))
 
     def run_round(self) -> RoundRecord:
         r = int(self.state.round_idx)
@@ -241,6 +263,9 @@ class Experiment:
                 brb_delivered, brb_failed = delivered, failed
                 msgs = self.trust.hub.messages_sent - m0
                 nbytes = self.trust.hub.bytes_sent - b0
+                if self.failure_cooldown_rounds > 0:
+                    for pid in failed:
+                        self._suspect_until[pid] = r + self.failure_cooldown_rounds
 
         with self.profiler.phase("eval"):
             ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)

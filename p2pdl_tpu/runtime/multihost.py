@@ -1,0 +1,179 @@
+"""Multi-host execution: DCN-spanning device mesh + host control plane.
+
+The reference "scales" by adding threads in one process (reference
+``main.py:24-36``); every node lives on one machine and the TCP mesh is
+loopback. The TPU-native multi-host shape is different in kind and this
+module is its entry point:
+
+- **Data plane**: one SPMD program over all hosts' devices. Each host runs
+  the same Python program; ``jax.distributed.initialize`` wires the hosts
+  into one runtime, ``global_mesh()`` builds a peer mesh over every device
+  in the job, and the compiled round from ``parallel.round`` runs unchanged
+  — XLA routes collectives over ICI within a slice and DCN across slices.
+  Each host feeds only its addressable shard of the peer-stacked data
+  (``host_local_batch``), exactly the device-put contract
+  ``jax.make_array_from_process_local_data`` expects.
+- **Control plane**: the BRB trust plane runs host-side over the framed-TCP
+  transport (``protocol.transport.TCPTransport``) between hosts — signatures
+  and quorum votes never touch the device program (SURVEY §5: control/data
+  plane split the reference lacks).
+
+Single-host (or simulation) callers never need this module; the driver uses
+the in-memory hub. ``initialize()`` is a no-op outside a multi-process
+launch, so the same experiment script works in all three deployments
+(simulation / single host / multi-host).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.parallel.mesh import PEER_AXIS
+
+# Environment contract (mirrors the standard JAX multi-process launch vars).
+COORDINATOR_ENV = "P2PDL_COORDINATOR"  # host:port of process 0
+PROCESS_ID_ENV = "P2PDL_PROCESS_ID"
+NUM_PROCESSES_ENV = "P2PDL_NUM_PROCESSES"
+
+
+@dataclasses.dataclass(frozen=True)
+class HostTopology:
+    """This process's place in the job."""
+
+    process_id: int
+    num_processes: int
+    local_devices: int
+    global_devices: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def initialize(
+    coordinator: Optional[str] = None,
+    process_id: Optional[int] = None,
+    num_processes: Optional[int] = None,
+) -> HostTopology:
+    """Join (or stand alone as) a multi-host job.
+
+    Args fall back to the ``P2PDL_*`` env vars; with neither present this is
+    a no-op single-process topology, so experiment scripts are deployment
+    agnostic. Must run before the first device query, like every
+    ``jax.distributed`` initialization.
+    """
+    coordinator = coordinator or os.environ.get(COORDINATOR_ENV)
+    if process_id is None:
+        process_id = int(os.environ.get(PROCESS_ID_ENV, "0"))
+    if num_processes is None:
+        num_processes = int(os.environ.get(NUM_PROCESSES_ENV, "1"))
+    if bool(coordinator) != (num_processes > 1):
+        # Half-configured multi-host would silently degrade to N independent
+        # single-host jobs (every host believing it is process 0).
+        raise ValueError(
+            f"inconsistent multi-host config: coordinator={coordinator!r} but "
+            f"num_processes={num_processes}; set both {COORDINATOR_ENV} and "
+            f"{NUM_PROCESSES_ENV} (>1), or neither"
+        )
+    if coordinator:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return HostTopology(
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        local_devices=jax.local_device_count(),
+        global_devices=jax.device_count(),
+    )
+
+
+def global_mesh() -> jax.sharding.Mesh:
+    """A 1-D peer mesh over every device of every host in the job.
+
+    ``jax.devices()`` in a multi-process runtime lists the global device set
+    in process order, so peer ids are contiguous per host — host h owns
+    peers ``[h*L*ppd, (h+1)*L*ppd)`` for L local devices — which keeps each
+    host's data shard addressable locally (no cross-host device_put).
+    """
+    return jax.sharding.Mesh(np.asarray(jax.devices()), (PEER_AXIS,))
+
+
+def peers_per_host(cfg: Config, topo: HostTopology, mesh: jax.sharding.Mesh) -> int:
+    """The one shared shard-size derivation (assumes the homogeneous
+    per-host device counts of a TPU pod slice — validated, not presumed)."""
+    if mesh.devices.size % topo.num_processes != 0 or (
+        topo.local_devices * topo.num_processes != mesh.devices.size
+    ):
+        raise ValueError(
+            f"heterogeneous hosts are unsupported: {topo.num_processes} "
+            f"processes x {topo.local_devices} local devices != "
+            f"{mesh.devices.size} global devices"
+        )
+    if cfg.num_peers % mesh.devices.size != 0:
+        raise ValueError(
+            f"num_peers ({cfg.num_peers}) must divide the global device count "
+            f"({mesh.devices.size})"
+        )
+    return cfg.num_peers // topo.num_processes
+
+
+def host_peer_slice(cfg: Config, topo: HostTopology, mesh: jax.sharding.Mesh) -> slice:
+    """The global peer-id range this host materializes data for."""
+    per_host = peers_per_host(cfg, topo, mesh)
+    start = topo.process_id * per_host
+    return slice(start, start + per_host)
+
+
+def host_local_batch(global_array: np.ndarray, cfg: Config, topo: HostTopology, mesh):
+    """Build the globally-sharded peer-stacked array from this host's shard.
+
+    ``global_array`` may be the full ``[P, ...]`` array (each host slices its
+    own range — convenient when data is generated deterministically from the
+    config seed, as the synthetic datasets are) or already the local
+    ``[P/num_hosts, ...]`` shard.
+    """
+    from p2pdl_tpu.parallel.mesh import peer_sharding
+
+    sh = peer_sharding(mesh)
+    per_host = peers_per_host(cfg, topo, mesh)
+    if global_array.shape[0] == cfg.num_peers:
+        local = (
+            global_array[host_peer_slice(cfg, topo, mesh)]
+            if topo.num_processes > 1
+            else global_array
+        )
+    elif global_array.shape[0] == per_host:
+        local = global_array
+    else:
+        raise ValueError(
+            f"array leading dim {global_array.shape[0]} is neither num_peers "
+            f"({cfg.num_peers}) nor the per-host shard ({per_host})"
+        )
+    if topo.num_processes == 1:
+        return jax.device_put(local, sh)
+    return jax.make_array_from_process_local_data(sh, np.asarray(local))
+
+
+def control_plane_transport(
+    my_peer_id: int,
+    bind_host: str,
+    bind_port: int,
+    handler,
+):
+    """Framed-TCP control-plane endpoint for the BRB trust plane between
+    hosts (the DCN path; simulation uses ``InMemoryHub`` instead). Thin
+    convenience over ``protocol.transport.TCPTransport``: same wire codec as
+    every other control message (length-prefixed JSON, no pickle)."""
+    from p2pdl_tpu.protocol.transport import TCPTransport
+
+    t = TCPTransport(my_peer_id, bind_host, bind_port, handler)
+    t.start()
+    return t

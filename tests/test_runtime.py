@@ -164,3 +164,50 @@ def test_cli_rejects_bad_flag(mesh8):
 
     with pytest.raises(SystemExit):
         main(["run", "--aggregator", "blockchain"])
+
+
+def test_failure_detection_excludes_peer_from_sampling(small_cfg, mesh8):
+    """A peer whose BRB delivery fails (all its inbound control messages
+    dropped) is excluded from trainer sampling for the cooldown window, then
+    re-admitted — the failure-detection/elastic-recovery behavior the
+    reference lacks entirely (its round would stall forever instead,
+    reference ``node/node.py:73``, ``utils/waiting.py``)."""
+    dead = 5
+    cfg = small_cfg.replace(brb_enabled=True, byzantine_f=2, round_timeout_s=2.0)
+    exp = Experiment(cfg, failure_cooldown_rounds=3)
+    exp.trust.hub.drop = lambda src, dst, data: dst == dead
+    record = exp.run_round()
+    assert dead in (record.brb_failed_peers or [])
+    r = record.round
+    for future in range(r + 1, r + 1 + 3):
+        assert dead not in exp.sample_roles(future), "suspect peer was sampled"
+    # Re-admitted exactly after the cooldown: eligible from round r+4 on
+    # (eligibility is suspect_until < round_idx).
+    assert exp._suspect_until[dead] < r + 4
+
+
+def test_multihost_single_process_topology(mesh8):
+    """The multi-host entry points in their single-process degenerate form:
+    initialize() is a no-op topology, the global mesh covers all local
+    devices, and host_local_batch round-trips a full peer-stacked array."""
+    import jax
+    import numpy as np
+
+    from p2pdl_tpu.config import Config
+    from p2pdl_tpu.runtime import multihost
+
+    topo = multihost.initialize()
+    assert topo.process_id == 0 and topo.num_processes == 1
+    assert topo.is_coordinator
+    mesh = multihost.global_mesh()
+    assert mesh.devices.size == jax.device_count()
+
+    cfg = Config(num_peers=2 * mesh.devices.size, trainers_per_round=2)
+    sl = multihost.host_peer_slice(cfg, topo, mesh)
+    assert (sl.start, sl.stop) == (0, cfg.num_peers)
+
+    x = np.arange(cfg.num_peers * 4, dtype=np.float32).reshape(cfg.num_peers, 4)
+    arr = multihost.host_local_batch(x, cfg, topo, mesh)
+    np.testing.assert_array_equal(np.asarray(arr), x)
+    with pytest.raises(ValueError, match="neither num_peers"):
+        multihost.host_local_batch(x[:3], cfg, topo, mesh)
