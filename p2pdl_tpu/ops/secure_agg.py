@@ -49,6 +49,10 @@ def pairwise_mask(
             )
             m = jax.random.normal(k, leaf.shape, jnp.float32)
             sgn = jnp.sign(other - my_id).astype(jnp.float32)
+            # Vacant slots (id -1, dynamic-participation padding) must not
+            # contribute: a mask keyed on a phantom pair has no counterparty
+            # to cancel against in the aggregate.
+            sgn = jnp.where(other >= 0, sgn, 0.0)
             return acc + sgn * m, None
 
         # Derive the accumulator from the leaf (not a fresh zeros) so its

@@ -182,6 +182,11 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
 
     ``trainer_idx``: ``[T]`` global peer ids of this round's trainers (the
     host round driver samples roles, mirroring reference ``main.py:52-54``).
+    For ``fedavg``/``secure_fedavg``, entries may be ``-1`` (vacant slot):
+    participation can shrink — e.g. after peer failures — without a
+    recompile, and the aggregate normalizes by the live trainer count. The
+    gathered robust reducers (krum/trimmed-mean/median) need their full
+    ``[T]`` update matrix, so they reject vacancy at the driver level.
     ``byz_gate``: ``[P]`` 1.0 for adversarial peers. ``mask_key``: PRNG key
     for secure-aggregation masks / noise attacks.
 
@@ -191,16 +196,14 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
     model = build_model(cfg)
     opt = make_optimizer(cfg)
     l_per_dev = peers_per_device(cfg.num_peers, mesh)
-    t = cfg.trainers_per_round
-
     if params_layout(cfg) == "peer":
         body = _gossip_body(cfg, mesh, attack, model, opt, l_per_dev)
         params_spec = P(PEER_AXIS)
     elif _use_fast_sync_path(cfg, attack):
-        body = _fast_sync_body(cfg, model, l_per_dev, t)
+        body = _fast_sync_body(cfg, model, l_per_dev)
         params_spec = P()
     else:
-        body = _general_sync_body(cfg, attack, model, opt, l_per_dev, t)
+        body = _general_sync_body(cfg, attack, model, opt, l_per_dev)
         params_spec = P()
 
     sp = P(PEER_AXIS)
@@ -262,7 +265,7 @@ def _gossip_body(cfg, mesh, attack, model, opt, l_per_dev):
     return body
 
 
-def _fast_sync_body(cfg, model, l_per_dev, t):
+def _fast_sync_body(cfg, model, l_per_dev):
     """Single-local-step plain-SGD FedAvg as one pooled gradient step.
 
     ``mean over trainers of (-lr·∇loss_peer) = -lr·∇(mean over trainers of
@@ -277,10 +280,13 @@ def _fast_sync_body(cfg, model, l_per_dev, t):
         dev = lax.axis_index(PEER_AXIS)
         local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
         gate = jnp.isin(local_ids, trainer_idx).astype(jnp.float32)
+        # Live trainer count (vacant -1 slots match no local id), so shrunken
+        # participation normalizes correctly.
+        count = jnp.maximum(lax.psum(jnp.sum(gate), PEER_AXIS), 1.0)
 
         def pooled_loss(p):
             losses = jax.vmap(lambda xp, yp: loss_fn(p, xp, yp))(x, y)  # [L]
-            return jnp.sum(losses * gate) / t, losses
+            return jnp.sum(losses * gate) / count, losses
 
         # pvary: differentiate w.r.t. a device-VARYING view of the replicated
         # params. Grad of a varying loss w.r.t. an invariant value would make
@@ -299,7 +305,7 @@ def _fast_sync_body(cfg, model, l_per_dev, t):
     return body
 
 
-def _general_sync_body(cfg, attack, model, opt, l_per_dev, t):
+def _general_sync_body(cfg, attack, model, opt, l_per_dev):
     """Role-based round over single-copy global params: broadcast the global
     model into a vmapped local-SGD phase (peers diverge only transiently),
     aggregate trainer deltas, apply one deterministic server update."""
@@ -332,10 +338,14 @@ def _general_sync_body(cfg, attack, model, opt, l_per_dev, t):
             )(delta, local_ids, is_trainer)
 
         if cfg.aggregator in ("fedavg", "secure_fedavg"):
+            count = jnp.maximum(
+                lax.psum(jnp.sum(is_trainer.astype(jnp.float32)), PEER_AXIS), 1.0
+            )
+
             # Masked-psum fast path: never materializes per-peer copies.
             def leaf(d):
                 w = is_trainer.astype(d.dtype).reshape((l_per_dev,) + (1,) * (d.ndim - 1))
-                return lax.psum(jnp.sum(d * w, axis=0), PEER_AXIS) / t
+                return lax.psum(jnp.sum(d * w, axis=0), PEER_AXIS) / count.astype(d.dtype)
 
             agg = jax.tree.map(leaf, delta)
         else:

@@ -227,14 +227,25 @@ class Experiment:
             ]
         )
         if len(eligible) < self.cfg.trainers_per_round:
-            # Too many suspects to fill the round: degrade gracefully to the
-            # full peer set rather than shrinking the trainer quorum.
+            if self.cfg.aggregator in ("fedavg", "secure_fedavg") and len(eligible) > 0:
+                # Shrink participation: run the round with the survivors; the
+                # compiled round accepts -1 vacancy padding and normalizes by
+                # the live count, so no recompile.
+                chosen = np.sort(eligible)
+                pad = np.full(self.cfg.trainers_per_round - len(chosen), -1, chosen.dtype)
+                return np.concatenate([chosen, pad])
+            # Robust reducers need their full [T] update matrix: degrade to
+            # the full peer set rather than shrinking the trainer quorum.
             eligible = np.arange(self.cfg.num_peers)
         return np.sort(rng.choice(eligible, self.cfg.trainers_per_round, replace=False))
 
     def run_round(self) -> RoundRecord:
         r = int(self.state.round_idx)
         trainers = self.sample_roles(r)
+        # -1 entries are vacancy padding for a shrunken round (see
+        # sample_roles); the device program consumes the padded vector, the
+        # host plane (trust, metrics, records) only the live peers.
+        live = trainers[trainers >= 0]
         t0 = time.perf_counter()
         with self.profiler.phase("round"):
             self.state, m = self.round_fn(
@@ -251,7 +262,7 @@ class Experiment:
             # Gossip has no roles: every peer trains, so every loss counts.
             losses = np.asarray(m["train_loss"])
             if self.cfg.aggregator != "gossip":
-                losses = losses[trainers]
+                losses = losses[live]
             train_loss = float(np.mean(losses))
 
         brb_delivered = brb_failed = msgs = nbytes = None
@@ -259,7 +270,7 @@ class Experiment:
             with self.profiler.phase("brb"):
                 fingerprints = np.asarray(m["fingerprint"])
                 m0, b0 = self.trust.hub.messages_sent, self.trust.hub.bytes_sent
-                delivered, failed = self.trust.run_round(r, trainers.tolist(), fingerprints)
+                delivered, failed = self.trust.run_round(r, live.tolist(), fingerprints)
                 brb_delivered, brb_failed = delivered, failed
                 msgs = self.trust.hub.messages_sent - m0
                 nbytes = self.trust.hub.bytes_sent - b0
@@ -271,7 +282,7 @@ class Experiment:
             ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)
         record = RoundRecord(
             round=r,
-            trainers=trainers.tolist(),
+            trainers=live.tolist(),
             train_loss=train_loss,
             eval_loss=float(ev["eval_loss"]),
             eval_acc=float(ev["eval_acc"]),

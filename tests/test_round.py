@@ -196,3 +196,41 @@ def test_secure_fedavg_matches_plain_fedavg(base_cfg, mesh8):
     # O(1e-4) relative noise on the loss trajectory.
     np.testing.assert_allclose(l_plain, l_sec, rtol=5e-3)
     np.testing.assert_allclose(e_plain["eval_acc"], e_sec["eval_acc"], atol=0.05)
+
+
+def test_vacant_trainer_slots_match_exact_subset(mesh8):
+    """A trainer vector padded with -1 vacancies (dynamic participation)
+    must aggregate identically to the same live set at full width, for both
+    plain and masked fedavg — vacancy changes the normalization count and
+    the pairwise mask set, nothing else."""
+    live = [0, 2, 5]
+    for aggregator in ("fedavg", "secure_fedavg"):
+        cfg = Config(
+            num_peers=8,
+            trainers_per_round=3,
+            local_epochs=1,
+            samples_per_peer=32,
+            batch_size=32,
+            lr=0.05,
+            server_lr=1.0,
+            dataset="mnist",
+            model="mlp",
+            aggregator=aggregator,
+            compute_dtype="float32",
+        )
+        data = make_federated_data(cfg, eval_samples=32)
+        results = []
+        for trainer_vec, t_width in ((live, 3), (live + [-1, -1], 5)):
+            c = cfg.replace(trainers_per_round=t_width)
+            state = init_peer_state(c)
+            state, x, y = _put(state, data, c, mesh8)
+            fn = build_round_fn(c, mesh8)
+            state, m = fn(
+                state, x, y,
+                jnp.asarray(trainer_vec, jnp.int32),
+                jnp.zeros(c.num_peers),
+                jax.random.PRNGKey(3),
+            )
+            results.append(state.params)
+        for a, b in zip(jax.tree.leaves(results[0]), jax.tree.leaves(results[1])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)

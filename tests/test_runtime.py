@@ -211,3 +211,28 @@ def test_multihost_single_process_topology(mesh8):
     np.testing.assert_array_equal(np.asarray(arr), x)
     with pytest.raises(ValueError, match="neither num_peers"):
         multihost.host_local_batch(x[:3], cfg, topo, mesh)
+
+
+def test_shrunken_round_after_mass_failure(small_cfg, mesh8):
+    """When suspects would starve the trainer quorum under fedavg, the round
+    shrinks (vacancy padding) instead of re-admitting suspects or stalling —
+    the opposite of the reference, which waits forever on dead peers."""
+    cfg = small_cfg.replace(
+        brb_enabled=True, byzantine_f=2, round_timeout_s=2.0,
+        trainers_per_round=7,
+    )
+    exp = Experiment(cfg, failure_cooldown_rounds=5)
+    # 2 of 8 peers dead — within the f=2 budget, so the live peers' quorums
+    # still complete (3 dead would correctly collapse every quorum). Leaves
+    # eligible (6) < trainers_per_round (7) -> shrink.
+    dead = {5, 7}
+    exp.trust.hub.drop = lambda src, dst, data: dst in dead
+    first = exp.run_round()
+    assert set(first.brb_failed_peers) == dead
+    nxt = exp.sample_roles(first.round + 1)
+    live = nxt[nxt >= 0]
+    assert len(nxt) == 7 and len(live) == 6
+    assert not set(live.tolist()) & dead
+    record = exp.run_round()  # executes with the padded trainer vector
+    assert set(record.trainers) == set(live.tolist())
+    assert np.isfinite(record.train_loss)
