@@ -108,14 +108,41 @@ def config_from_args(args: argparse.Namespace) -> Config:
     )
 
 
+def _warn(msg: str) -> None:
+    """JSON warning on stderr — stdout stays a clean JSONL record stream."""
+    print(json.dumps({"warning": msg}), file=sys.stderr)
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.platform is not None:
         import jax
 
-        jax.config.update("jax_platforms", args.platform)
-        if args.platform == "cpu" and args.n_devices is not None:
-            jax.config.update("jax_num_cpu_devices", args.n_devices)
+        # Backend choice is effectively final once any device has been
+        # queried (e.g. a sitecustomize that touches jax at interpreter
+        # start): jax_num_cpu_devices raises RuntimeError post-init, while
+        # jax_platforms silently no-ops. Handle both — warn and continue on
+        # whatever backend exists instead of crashing the CLI.
+        try:
+            jax.config.update("jax_platforms", args.platform)
+            if args.platform == "cpu" and args.n_devices is not None:
+                jax.config.update("jax_num_cpu_devices", args.n_devices)
+        except RuntimeError as e:
+            _warn(f"--n-devices not applied: {e}")
+        if jax.default_backend() != args.platform:
+            _warn(
+                f"--platform {args.platform} not honored; "
+                f"running on {jax.default_backend()}"
+            )
+    if args.n_devices is not None:
+        import jax
+
+        if args.n_devices > len(jax.devices()):
+            _warn(
+                f"--n-devices {args.n_devices} unavailable; "
+                f"using all {len(jax.devices())} devices"
+            )
+            args.n_devices = None
     cfg = config_from_args(args)
     byz_ids = tuple(int(x) for x in args.byz_ids.split(",") if x.strip())
 

@@ -164,11 +164,15 @@ def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
     """The pooled-gradient round is exact iff local training is one plain-SGD
     step (delta = -lr·grad, linear in the gradient), nothing perturbs
     per-peer deltas (no attack, no per-peer masking semantics to simulate),
-    and nothing downstream needs them (no BRB fingerprints)."""
+    and nothing downstream needs them (no BRB commitments). ``remat`` routes
+    to the general path, whose local trainer honors ``jax.checkpoint`` — the
+    fast path pools every trainer's batch into one forward/backward, which is
+    exactly the memory shape a remat request is trying to avoid."""
     return (
         cfg.aggregator == "fedavg"
         and attack == "none"
         and not cfg.brb_enabled
+        and not cfg.remat
         and cfg.momentum == 0.0
         and cfg.local_epochs == 1
         and cfg.batches_per_epoch == 1

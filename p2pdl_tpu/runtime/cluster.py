@@ -119,24 +119,13 @@ class Cluster:
             self._expected_trainers = None
         if trainers is None:
             return
-        # Override the experiment's own sampling with the cluster's roles.
-        record = self._run_round_with(trainers)
+        # The cluster's consented roles, not the experiment's own sampling.
+        record = self.experiment.run_round(trainers=trainers)
         self.last_record = record
         failed = set(record.brb_failed_peers or [])
         for node in self.nodes:
             if node.node_id not in failed:
                 node._delivered.set()
-
-    def _run_round_with(self, trainers: list[int]) -> RoundRecord:
-        exp = self.experiment
-        sample = exp.sample_roles
-        import numpy as np
-
-        exp.sample_roles = lambda round_idx=None: np.asarray(sorted(trainers))  # type: ignore[assignment]
-        try:
-            return exp.run_round()
-        finally:
-            exp.sample_roles = sample  # type: ignore[assignment]
 
     def run_round(self, trainers: Optional[list[int]] = None) -> RoundRecord:
         """Drive one full round directly (the orchestration in

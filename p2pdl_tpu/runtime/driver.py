@@ -239,9 +239,20 @@ class Experiment:
             eligible = np.arange(self.cfg.num_peers)
         return np.sort(rng.choice(eligible, self.cfg.trainers_per_round, replace=False))
 
-    def run_round(self) -> RoundRecord:
+    def run_round(self, trainers: Optional[np.ndarray] = None) -> RoundRecord:
+        """Run one round. ``trainers`` overrides role sampling (the Cluster
+        facade passes the set its Nodes consented to, reference
+        ``main.py:59-76``); default samples per ``sample_roles``."""
         r = int(self.state.round_idx)
-        trainers = self.sample_roles(r)
+        if trainers is None:
+            trainers = self.sample_roles(r)
+        else:
+            trainers = np.sort(np.asarray(trainers, dtype=np.int64))
+            if len(trainers) != self.cfg.trainers_per_round:
+                raise ValueError(
+                    f"explicit trainer list has {len(trainers)} entries, "
+                    f"config expects trainers_per_round={self.cfg.trainers_per_round}"
+                )
         # -1 entries are vacancy padding for a shrunken round (see
         # sample_roles); the device program consumes the padded vector, the
         # host plane (trust, metrics, records) only the live peers.

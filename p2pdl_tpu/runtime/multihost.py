@@ -98,12 +98,15 @@ def initialize(
 def global_mesh() -> jax.sharding.Mesh:
     """A 1-D peer mesh over every device of every host in the job.
 
-    ``jax.devices()`` in a multi-process runtime lists the global device set
-    in process order, so peer ids are contiguous per host — host h owns
-    peers ``[h*L*ppd, (h+1)*L*ppd)`` for L local devices — which keeps each
-    host's data shard addressable locally (no cross-host device_put).
+    Host h must own the contiguous peer range ``[h*L*ppd, (h+1)*L*ppd)`` for
+    L local devices, or each host's data shard would not be locally
+    addressable. ``jax.devices()`` usually lists devices in process order
+    already, but that is a convention, not a contract — sort by
+    ``(process_index, id)`` so the mesh order is guaranteed contiguous
+    per host rather than assumed.
     """
-    return jax.sharding.Mesh(np.asarray(jax.devices()), (PEER_AXIS,))
+    devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    return jax.sharding.Mesh(np.asarray(devices), (PEER_AXIS,))
 
 
 def peers_per_host(cfg: Config, topo: HostTopology, mesh: jax.sharding.Mesh) -> int:

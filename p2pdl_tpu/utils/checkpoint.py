@@ -21,7 +21,7 @@ from typing import Any, Optional
 import jax
 
 from p2pdl_tpu.config import Config
-from p2pdl_tpu.parallel.peer_state import PeerState, init_peer_state
+from p2pdl_tpu.parallel.peer_state import PeerState, init_peer_state, params_layout
 
 try:  # pragma: no cover - exercised implicitly by every test below
     import orbax.checkpoint as ocp
@@ -129,6 +129,11 @@ class Checkpointer:
             step, args=ocp.args.Composite(config=ocp.args.JsonRestore())
         )["config"]
         saved_version = meta.get("format_version", 1)
+        # v1 -> v2 changed only the sync-layout params (peer-stacked -> one
+        # global copy); the peer layout (gossip) is byte-identical across
+        # versions, so its v1 checkpoints stay restorable.
+        if saved_version == 1 and FORMAT_VERSION == 2 and params_layout(cfg) == "peer":
+            saved_version = FORMAT_VERSION
         if saved_version != FORMAT_VERSION:
             raise ValueError(
                 f"checkpoint at {self.directory} step {step} has state-layout "

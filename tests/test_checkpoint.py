@@ -94,6 +94,30 @@ def test_final_state_checkpointed_with_sparse_cadence(tmp_path):
     assert resumed.run() == []  # nothing left to run, no duplicate records
 
 
+def test_v1_gossip_checkpoint_restorable(tmp_path, monkeypatch):
+    """v1 -> v2 changed only the sync param layout; gossip's peer-stacked
+    layout is byte-identical across versions, so a v1 gossip checkpoint must
+    restore — while a v1 sync checkpoint stays rejected."""
+    from p2pdl_tpu.utils import checkpoint as ckpt_mod
+
+    gossip = TINY.replace(aggregator="gossip")
+    state = init_peer_state(gossip)
+    ck = Checkpointer(str(tmp_path / "gossip"))
+    with monkeypatch.context() as m:
+        m.setattr(ckpt_mod, "FORMAT_VERSION", 1)
+        ck.save(state, gossip)
+    restored = ck.restore(gossip)
+    assert _trees_equal(state.params, restored.params)
+
+    sync_state = init_peer_state(TINY)
+    ck2 = Checkpointer(str(tmp_path / "sync"))
+    with monkeypatch.context() as m:
+        m.setattr(ckpt_mod, "FORMAT_VERSION", 1)
+        ck2.save(sync_state, TINY)
+    with pytest.raises(ValueError, match="format"):
+        ck2.restore(TINY)
+
+
 def test_missing_checkpoint_raises(tmp_path):
     ck = Checkpointer(str(tmp_path / "empty"))
     assert ck.latest_step() is None

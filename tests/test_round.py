@@ -122,6 +122,40 @@ def test_fast_path_matches_general(mesh8):
     np.testing.assert_allclose(np.asarray(l_fast), np.asarray(l_gen), atol=1e-5)
 
 
+def test_remat_routes_off_fast_path_and_matches(mesh8):
+    """``remat=True`` must not be silently ignored: it routes to the general
+    path (whose local trainer applies ``jax.checkpoint``), and remat must not
+    change the numbers — only the memory schedule."""
+    from p2pdl_tpu.parallel.round import _use_fast_sync_path
+
+    cfg = Config(
+        num_peers=8,
+        trainers_per_round=6,
+        local_epochs=1,
+        samples_per_peer=32,
+        batch_size=32,
+        lr=0.05,
+        server_lr=0.7,
+        dataset="mnist",
+        model="mlp",
+        compute_dtype="float32",
+    )
+    assert _use_fast_sync_path(cfg, "none")
+    assert not _use_fast_sync_path(cfg.replace(remat=True), "none")
+
+    data = make_federated_data(cfg, eval_samples=16)
+    trainer_idx = jnp.asarray([0, 2, 3, 5, 6, 7], jnp.int32)
+    results = []
+    for c in (cfg, cfg.replace(remat=True)):
+        state = init_peer_state(c)
+        state, x, y = _put(state, data, c, mesh8)
+        fn = build_round_fn(c, mesh8)
+        state, _ = fn(state, x, y, trainer_idx, jnp.zeros(c.num_peers), jax.random.PRNGKey(0))
+        results.append(state.params)
+    for a, b in zip(jax.tree.leaves(results[0]), jax.tree.leaves(results[1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
 def test_round_idx_advances(base_cfg, mesh8):
     state, _, _ = _run_rounds(base_cfg, mesh8, n_rounds=3)
     assert int(state.round_idx) == 3

@@ -159,6 +159,35 @@ def test_cli_run(capsys, mesh8):
     assert rec["brb_delivered"] == 8
 
 
+def test_cli_platform_flag_after_backend_init(capsys, mesh8):
+    """``--platform`` once backends are initialized (jax_num_cpu_devices can
+    no longer change) must warn and continue, not crash the CLI."""
+    from p2pdl_tpu.cli import main
+
+    rc = main(
+        [
+            "run",
+            "--platform", "cpu", "--n-devices", "8",
+            "--num-peers", "8", "--trainers-per-round", "3", "--rounds", "1",
+            "--local-epochs", "1", "--samples-per-peer", "32",
+        ]
+    )
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert any(
+        "round" in json.loads(l)
+        for l in captured.out.strip().splitlines()
+        if l.startswith("{")
+    )
+    # The ignored flag must be surfaced as a JSON warning on stderr (stdout
+    # stays a clean record stream).
+    assert any(
+        "warning" in json.loads(l)
+        for l in captured.err.strip().splitlines()
+        if l.startswith("{")
+    )
+
+
 def test_cli_rejects_bad_flag(mesh8):
     from p2pdl_tpu.cli import main
 
@@ -201,6 +230,10 @@ def test_multihost_single_process_topology(mesh8):
     assert topo.is_coordinator
     mesh = multihost.global_mesh()
     assert mesh.devices.size == jax.device_count()
+    # The mesh order must be (process_index, id)-sorted — guaranteed, not
+    # assumed from jax.devices() enumeration order.
+    keys = [(d.process_index, d.id) for d in mesh.devices.flat]
+    assert keys == sorted(keys)
 
     cfg = Config(num_peers=2 * mesh.devices.size, trainers_per_round=2)
     sl = multihost.host_peer_slice(cfg, topo, mesh)
