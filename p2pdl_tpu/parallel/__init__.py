@@ -17,7 +17,11 @@ from p2pdl_tpu.parallel.peer_state import (
     params_layout,
     shard_state,
 )
-from p2pdl_tpu.parallel.round import build_round_fn, build_eval_fn
+from p2pdl_tpu.parallel.round import (
+    build_eval_fn,
+    build_round_fn,
+    build_trust_round_fns,
+)
 
 __all__ = [
     "make_mesh",
@@ -29,5 +33,6 @@ __all__ = [
     "global_params",
     "params_layout",
     "build_round_fn",
+    "build_trust_round_fns",
     "build_eval_fn",
 ]

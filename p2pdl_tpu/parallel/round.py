@@ -143,23 +143,6 @@ def _aggregate(cfg: Config, deltas_trainers: Any) -> Any:
     raise ValueError(f"no gathered-reducer for {cfg.aggregator!r}")
 
 
-def _fingerprint(cfg: Config, delta: Any, l_per_dev: int) -> jnp.ndarray:
-    """Per-peer per-leaf squared delta norms: an on-device commitment the
-    host trust plane signs/BRB-broadcasts without ever transferring the
-    update itself (32 bytes of digest per peer vs the reference pickling
-    ~2 MB of weights per message, SURVEY §3.5). Computed only when the trust
-    plane is on — it is an extra full pass over the deltas."""
-    if not cfg.brb_enabled:
-        return jnp.zeros((l_per_dev, 1), jnp.float32)
-    return jnp.stack(
-        [
-            jnp.sum(l.astype(jnp.float32) ** 2, axis=tuple(range(1, l.ndim)))
-            for l in jax.tree.leaves(delta)
-        ],
-        axis=1,
-    )  # [L, n_leaves]
-
-
 def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
     """The pooled-gradient round is exact iff local training is one plain-SGD
     step (delta = -lr·grad, linear in the gradient), nothing perturbs
@@ -181,18 +164,24 @@ def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
 
 
 def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
-    """Compile the round: ``(state, x, y, trainer_idx, byz_gate, mask_key) ->
-    (state', metrics)``.
+    """Compile the fused round: ``(state, x, y, trainer_idx, byz_gate,
+    mask_key) -> (state', metrics)``.
 
     ``trainer_idx``: ``[T]`` global peer ids of this round's trainers (the
     host round driver samples roles, mirroring reference ``main.py:52-54``).
     For ``fedavg``/``secure_fedavg``, entries may be ``-1`` (vacant slot):
-    participation can shrink — e.g. after peer failures — without a
-    recompile, and the aggregate normalizes by the live trainer count. The
-    gathered robust reducers (krum/trimmed-mean/median) need their full
-    ``[T]`` update matrix, so they reject vacancy at the driver level.
-    ``byz_gate``: ``[P]`` 1.0 for adversarial peers. ``mask_key``: PRNG key
-    for secure-aggregation masks / noise attacks.
+    participation can shrink — e.g. after peer failures or BRB delivery
+    failures — without a recompile, and the aggregate normalizes by the live
+    trainer count. The gathered robust reducers (krum/trimmed-mean/median)
+    need their full ``[T]`` update matrix, so they reject vacancy at the
+    driver level. ``byz_gate``: ``[P]`` 1.0 for adversarial peers.
+    ``mask_key``: PRNG key for secure-aggregation masks / noise attacks.
+
+    For sync layouts with the trust plane on, the driver uses
+    :func:`build_trust_round_fns` instead, so the BRB outcome can gate the
+    aggregate *between* the two compiled phases. The fused round still
+    serves gossip with BRB (observational trust: the mix is in-band, so
+    ``metrics["delta"]`` exposes per-peer deltas for digest broadcast).
 
     The input ``state`` is donated: the round overwrites it in place, so the
     caller must use the returned state (all call sites thread it through).
@@ -200,8 +189,10 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
     model = build_model(cfg)
     opt = make_optimizer(cfg)
     l_per_dev = peers_per_device(cfg.num_peers, mesh)
+    emit_delta = False
     if params_layout(cfg) == "peer":
-        body = _gossip_body(cfg, mesh, attack, model, opt, l_per_dev)
+        emit_delta = cfg.brb_enabled
+        body = _gossip_body(cfg, mesh, attack, model, opt, l_per_dev, emit_delta)
         params_spec = P(PEER_AXIS)
     elif _use_fast_sync_path(cfg, attack):
         body = _fast_sync_body(cfg, model, l_per_dev)
@@ -216,11 +207,11 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
         body,
         mesh=mesh,
         in_specs=(params_spec, sp, sp, sp, sp, sr, sr, sr, sr),
-        out_specs=(params_spec, sp, sp, sp),
+        out_specs=(params_spec, sp, sp) + ((sp,) if emit_delta else ()),
     )
 
     def round_fn(state: PeerState, x, y, trainer_idx, byz_gate, mask_key):
-        new_params, new_opt, losses, fingerprint = smapped(
+        out = smapped(
             state.params,
             state.opt_state,
             state.rng,
@@ -231,13 +222,17 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
             state.round_idx,
             mask_key,
         )
+        new_params, new_opt, losses = out[:3]
+        metrics = {"train_loss": losses}
+        if emit_delta:
+            metrics["delta"] = out[3]
         new_state = PeerState(
             params=new_params,
             opt_state=new_opt,
             rng=state.rng,
             round_idx=state.round_idx + 1,
         )
-        return new_state, {"train_loss": losses, "fingerprint": fingerprint}
+        return new_state, metrics
 
     # Donate the state: without it every round copies the full working set
     # (for gossip, num_peers × model) through HBM just to preserve a buffer
@@ -245,10 +240,92 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
     return jax.jit(round_fn, donate_argnums=(0,))
 
 
-def _gossip_body(cfg, mesh, attack, model, opt, l_per_dev):
+def build_trust_round_fns(cfg: Config, mesh: Mesh, attack: str = "none") -> tuple[Callable, Callable]:
+    """The BRB-gated round: local training and aggregation as two compiled
+    programs with the host trust plane deciding between them which trainers'
+    updates the aggregate admits.
+
+    This is the reference's core security semantic — a tester accumulates
+    exactly the updates it received and signature-verified (reference
+    ``node/node.py:130-145`` feeds ``received_models``;
+    ``aggregator/aggregation.py:8-28`` consumes them) — realized SPMD-style:
+
+    - ``train_fn(state, x, y, byz_gate, mask_key) -> (delta, new_opt,
+      losses)``: every peer's local SGD; per-peer deltas stay on device.
+    - The driver digests each live trainer's delta
+      (``crypto.digest_update``), BRB-broadcasts the digests, and replaces
+      undelivered/unverified trainers with ``-1`` in the trainer vector.
+    - ``agg_fn(state, delta, new_opt, trainer_idx, mask_key) -> state'``:
+      masked aggregation over the *gated* trainer vector + server update.
+      A gated-out trainer contributes nothing to this round's aggregate (and
+      its optimizer state does not advance, exactly as if never sampled).
+
+    Gating applies to the mean family (fedavg/secure_fedavg, via ``-1``
+    vacancy). The gathered robust reducers take their full update matrix —
+    they are content-robust in-band by construction (tolerate f Byzantine
+    updates) — so for them delivery failures remain observational (next-round
+    sampling exclusion), which the driver handles.
+
+    Gossip (peer layout) has no admit step — the mix is in-band — so it uses
+    the fused round; requesting the split pipeline for it is an error.
+    """
+    if params_layout(cfg) == "peer":
+        raise ValueError("gossip has no gated aggregate; use build_round_fn")
+    model = build_model(cfg)
+    opt = make_optimizer(cfg)
+    l_per_dev = peers_per_device(cfg.num_peers, mesh)
+    train = _local_train_phase(cfg, attack, model, opt, l_per_dev)
+    agg = _aggregate_phase(cfg, l_per_dev)
+    sp = P(PEER_AXIS)
+    sr = P()
+    train_smapped = jax.shard_map(
+        train,
+        mesh=mesh,
+        in_specs=(sr, sp, sp, sp, sp, sr, sr, sr),
+        out_specs=(sp, sp, sp),
+    )
+    agg_smapped = jax.shard_map(
+        agg,
+        mesh=mesh,
+        in_specs=(sr, sp, sp, sp, sr, sr),
+        out_specs=(sr, sp),
+    )
+
+    def train_fn(state: PeerState, x, y, byz_gate, mask_key):
+        return train_smapped(
+            state.params,
+            state.opt_state,
+            state.rng,
+            x,
+            y,
+            byz_gate,
+            state.round_idx,
+            mask_key,
+        )
+
+    def agg_fn(state: PeerState, delta, new_opt, trainer_idx, mask_key):
+        new_params, kept_opt = agg_smapped(
+            state.params, state.opt_state, new_opt, delta, trainer_idx, mask_key
+        )
+        return PeerState(
+            params=new_params,
+            opt_state=kept_opt,
+            rng=state.rng,
+            round_idx=state.round_idx + 1,
+        )
+
+    # agg_fn consumes the round's transients (deltas + trained opt state) and
+    # the previous state — donate all three; train_fn's inputs are all read
+    # again by agg_fn, so it donates nothing.
+    return jax.jit(train_fn), jax.jit(agg_fn, donate_argnums=(0, 1, 2))
+
+
+def _gossip_body(cfg, mesh, attack, model, opt, l_per_dev, emit_delta=False):
     """Decentralized averaging (D-PSGD): peer-stacked params; every peer
     trains, then mixes parameters with its ring neighbors — no roles, no
-    global sync. Byzantine peers mix their corrupted params into the ring."""
+    global sync. Byzantine peers mix their corrupted params into the ring.
+    With ``emit_delta`` (trust plane on) the per-peer deltas are returned so
+    the host can digest-broadcast them."""
     local_train = make_local_train(cfg, model, opt)
 
     def body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
@@ -261,10 +338,11 @@ def _gossip_body(cfg, mesh, attack, model, opt, l_per_dev):
         delta = jax.tree.map(lambda n, p: n - p, new_params, params)
         gate = byz_gate[local_ids]
         delta = apply_attack(attack, delta, gate, jax.random.fold_in(mask_key, dev))
-        fingerprint = _fingerprint(cfg, delta, l_per_dev)
         attacked = jax.tree.map(lambda p, d: p + d, params, delta)
         mixed = ring_mix(attacked)
-        return mixed, new_opt, losses, fingerprint
+        if emit_delta:
+            return mixed, new_opt, losses, delta
+        return mixed, new_opt, losses
 
     return body
 
@@ -304,18 +382,19 @@ def _fast_sync_body(cfg, model, l_per_dev):
         new_p = jax.tree.map(
             lambda p, g: p - (cfg.server_lr * cfg.lr) * g.astype(p.dtype), params, grads
         )
-        return new_p, opt_state, losses, _fingerprint(cfg, None, l_per_dev)
+        return new_p, opt_state, losses
 
     return body
 
 
-def _general_sync_body(cfg, attack, model, opt, l_per_dev):
-    """Role-based round over single-copy global params: broadcast the global
-    model into a vmapped local-SGD phase (peers diverge only transiently),
-    aggregate trainer deltas, apply one deterministic server update."""
+def _local_train_phase(cfg, attack, model, opt, l_per_dev):
+    """Phase fragment (inside ``shard_map``): every peer's local SGD from the
+    replicated global params, returning the (possibly attacked) per-peer
+    deltas — the round up to the point where the reference's trainer ships
+    its update (reference ``node/node.py:265-297``)."""
     local_train = make_local_train(cfg, model, opt)
 
-    def body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
+    def phase(params, opt_state, rng, x, y, byz_gate, round_idx, mask_key):
         dev = lax.axis_index(PEER_AXIS)
         local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
         round_keys = jax.vmap(lambda k: jax.random.fold_in(k, round_idx))(rng)
@@ -332,8 +411,20 @@ def _general_sync_body(cfg, attack, model, opt, l_per_dev):
         delta = jax.tree.map(lambda n, p: n - p[None], new_params, pvaried)
         gate = byz_gate[local_ids]
         delta = apply_attack(attack, delta, gate, jax.random.fold_in(mask_key, dev))
-        fingerprint = _fingerprint(cfg, delta, l_per_dev)
+        return delta, new_opt, losses
 
+    return phase
+
+
+def _aggregate_phase(cfg, l_per_dev):
+    """Phase fragment (inside ``shard_map``): admit the trainer-gated deltas
+    into the aggregate, apply one deterministic server update, and advance
+    only trainers' optimizer state — the reference's tester-side
+    accumulate/average/apply (reference ``aggregator/aggregation.py:15-38``)."""
+
+    def phase(params, opt_state, new_opt, delta, trainer_idx, mask_key):
+        dev = lax.axis_index(PEER_AXIS)
+        local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
         is_trainer = jnp.isin(local_ids, trainer_idx)
 
         if cfg.aggregator == "secure_fedavg":
@@ -376,12 +467,32 @@ def _general_sync_body(cfg, attack, model, opt, l_per_dev):
         # (non-trainers idle, ``main.py:72-80``): their optimizer state
         # (momentum, if enabled) must not advance. The optimizer is per-peer
         # for the experiment's lifetime (reference ``node/node.py:30``).
+        # Under BRB gating this also rolls back excluded trainers' optimizer
+        # advance — a gated-out trainer is treated exactly as never sampled.
         def keep_trainers(n, o):
             m = is_trainer.reshape((l_per_dev,) + (1,) * (n.ndim - 1))
             return jnp.where(m, n, o)
 
         new_opt = jax.tree.map(keep_trainers, new_opt, opt_state)
-        return new_p, new_opt, losses, fingerprint
+        return new_p, new_opt
+
+    return phase
+
+
+def _general_sync_body(cfg, attack, model, opt, l_per_dev):
+    """Role-based round over single-copy global params: broadcast the global
+    model into a vmapped local-SGD phase (peers diverge only transiently),
+    aggregate trainer deltas, apply one deterministic server update. One
+    fused program = the two phase fragments composed with no host boundary."""
+    train = _local_train_phase(cfg, attack, model, opt, l_per_dev)
+    agg = _aggregate_phase(cfg, l_per_dev)
+
+    def body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
+        delta, new_opt, losses = train(
+            params, opt_state, rng, x, y, byz_gate, round_idx, mask_key
+        )
+        new_p, kept_opt = agg(params, opt_state, new_opt, delta, trainer_idx, mask_key)
+        return new_p, kept_opt, losses
 
     return body
 

@@ -32,13 +32,15 @@ from p2pdl_tpu.data import make_federated_data
 from p2pdl_tpu.parallel import (
     build_eval_fn,
     build_round_fn,
+    build_trust_round_fns,
     init_peer_state,
     make_mesh,
+    params_layout,
     peer_sharding,
     shard_state,
 )
 from p2pdl_tpu.protocol.brb import BRBConfig, Broadcaster
-from p2pdl_tpu.protocol.crypto import KeyServer, generate_key_pair
+from p2pdl_tpu.protocol.crypto import KeyServer, digest_update, generate_key_pair
 from p2pdl_tpu.protocol.transport import InMemoryHub, brb_from_wire, brb_to_wire
 from p2pdl_tpu.utils.metrics import MetricsLogger
 from p2pdl_tpu.utils.profiling import Profiler
@@ -54,6 +56,9 @@ class RoundRecord:
     duration_s: float
     brb_delivered: Optional[int] = None  # peers that delivered all trainer broadcasts
     brb_failed_peers: Optional[list[int]] = None
+    # Trainers whose commitment did not deliver+verify; under fedavg-family
+    # aggregation they were gated out of THIS round's aggregate.
+    brb_excluded_trainers: Optional[list[int]] = None
     control_messages: Optional[int] = None
     control_bytes: Optional[int] = None
 
@@ -62,12 +67,19 @@ class RoundRecord:
 
 
 class _TrustPlane:
-    """Host-side BRB over update fingerprints for one experiment.
+    """Host-side BRB over canonical update digests for one experiment.
 
-    Each round, every trainer BRB-broadcasts the digest of its on-device
-    update fingerprint; every peer must deliver every trainer's broadcast.
-    Runs over the deterministic in-memory hub (the TCP transport serves the
-    multi-host control plane; simulation never needs sockets).
+    Each round, every trainer BRB-broadcasts ``crypto.digest_update`` of its
+    actual delta (a collision-resistant SHA-256 commitment to the update's
+    content — not the forgeable norm fingerprint of earlier builds); every
+    peer must deliver every trainer's broadcast, and a delivered commitment
+    is verified against the update the aggregate would admit. Runs over the
+    deterministic in-memory hub (the TCP transport serves the multi-host
+    control plane; simulation never needs sockets).
+
+    ``lie_digests``: fault-injection hook — trainer id -> digest it falsely
+    (but consistently) commits to, modeling a trainer whose broadcast
+    delivers fine but does not match the update it actually submitted.
     """
 
     def __init__(self, cfg: Config, byz_ids: tuple[int, ...] = ()) -> None:
@@ -75,6 +87,7 @@ class _TrustPlane:
         self.key_server = KeyServer()
         self.hub = InMemoryHub()
         self.byz_ids = set(byz_ids)
+        self.lie_digests: dict[int, bytes] = {}
         self.broadcasters: list[Broadcaster] = []
         brb_cfg = BRBConfig(cfg.num_peers, cfg.byzantine_f)
         self._keys = []
@@ -103,23 +116,33 @@ class _TrustPlane:
         for dst in range(self.cfg.num_peers):
             self.hub.send(src, dst, wire)
 
+    def _payload(self, round_idx: int, tid: int, digest: bytes) -> bytes:
+        return json.dumps(
+            {"round": round_idx, "trainer": tid, "digest": digest.hex()}
+        ).encode()
+
     def run_round(
-        self, round_idx: int, trainer_ids: list[int], fingerprints: np.ndarray
-    ) -> tuple[int, list[int]]:
-        """Broadcast each trainer's fingerprint; returns (#peers that
-        delivered every *honest* trainer's broadcast, ids of peers that did
-        not). Byzantine trainers equivocate: half the peers receive a forged
-        fingerprint — correct BRB then either delivers one payload
-        consistently or (echo vote split) delivers nothing; a Byzantine
-        trainer's broadcast is therefore excluded from the delivery check."""
+        self, round_idx: int, trainer_ids: list[int], digests: dict[int, bytes]
+    ) -> tuple[int, list[int], list[int]]:
+        """Broadcast each trainer's update digest; returns ``(#peers that
+        delivered every honest trainer's broadcast, ids of peers that did
+        not, ids of trainers whose commitment both delivered and verified)``.
+
+        A trainer makes the verified list iff (a) every non-failed peer
+        delivered its broadcast, and (b) the delivered commitment matches
+        ``digests[tid]`` — the digest of the update the aggregate would
+        actually admit (each peer's verify step; in simulation all peers
+        share the device state, so one recomputation stands for all).
+        Byzantine trainers equivocate: half the peers receive a forged
+        digest — correct BRB then either delivers one payload consistently
+        (caught by (b)) or delivers nothing (caught by (a))."""
         for tid in trainer_ids:
-            payload = json.dumps(
-                {"round": round_idx, "trainer": tid, "fingerprint": fingerprints[tid].tolist()}
-            ).encode()
+            committed = self.lie_digests.get(tid, digests[tid])
+            payload = self._payload(round_idx, tid, committed)
             if tid in self.byz_ids:
-                forged = json.dumps(
-                    {"round": round_idx, "trainer": tid, "fingerprint": "forged"}
-                ).encode()
+                forged = self._payload(
+                    round_idx, tid, b"\x00" * 31 + bytes([tid % 256])
+                )
                 send_a, send_b = self.broadcasters[tid].broadcast_equivocating(
                     round_idx, payload, forged
                 )
@@ -134,17 +157,44 @@ class _TrustPlane:
         while self.hub.pump() and time.monotonic() < deadline:
             pass
         honest_trainers = [t for t in trainer_ids if t not in self.byz_ids]
-        failed = []
-        for pid in range(self.cfg.num_peers):
-            ok = all(
-                self.broadcasters[pid].delivered(tid, round_idx) is not None
+        delivered_at = {
+            tid: [
+                pid
+                for pid in range(self.cfg.num_peers)
+                if self.broadcasters[pid].delivered(tid, round_idx) is not None
+            ]
+            for tid in trainer_ids
+        }
+        # Sender vs receiver failure: a broadcast nobody delivered is the
+        # SENDER's failure (dead or equivocating trainer) — it must not mark
+        # every receiver suspect. A peer is failed iff it missed a broadcast
+        # its peers did deliver (Bracha totality: once one honest peer
+        # delivers, all honest peers do — the hub pumps to quiescence, so
+        # non-delivery at quiescence is a real receiver fault).
+        sender_failed = {t for t in honest_trainers if not delivered_at[t]}
+        failed = [
+            pid
+            for pid in range(self.cfg.num_peers)
+            if any(
+                pid not in delivered_at[tid]
                 for tid in honest_trainers
+                if tid not in sender_failed
             )
-            if not ok:
-                failed.append(pid)
+        ]
+        live_peers = [p for p in range(self.cfg.num_peers) if p not in failed]
+        verified: list[int] = []
+        for tid in trainer_ids:
+            expected = self._payload(round_idx, tid, digests[tid])
+            # live_peers can only be empty under total failure — nothing is
+            # verified then (no vacuous-truth admits).
+            if live_peers and all(
+                self.broadcasters[pid].delivered(tid, round_idx) == expected
+                for pid in live_peers
+            ):
+                verified.append(tid)
         for bc in self.broadcasters:
             bc.prune(round_idx)
-        return self.cfg.num_peers - len(failed), failed
+        return self.cfg.num_peers - len(failed), failed, verified
 
 
 class Experiment:
@@ -175,7 +225,15 @@ class Experiment:
         self._suspect_until: dict[int, int] = {}
         self.mesh = make_mesh(n_devices)
         self.data = make_federated_data(cfg)
-        self.round_fn = build_round_fn(cfg, self.mesh, attack=attack)
+        # Sync layouts with the trust plane on use the split (two-program)
+        # round so the BRB verdict gates the aggregate between the phases;
+        # everything else runs the fused single-program round.
+        self._gated = cfg.brb_enabled and params_layout(cfg) == "sync"
+        if self._gated:
+            self.train_fn, self.agg_fn = build_trust_round_fns(cfg, self.mesh, attack=attack)
+            self.round_fn = None
+        else:
+            self.round_fn = build_round_fn(cfg, self.mesh, attack=attack)
         self.eval_fn = build_eval_fn(cfg)
         self.metrics = MetricsLogger(log_path)
         self.trust = _TrustPlane(cfg, byz_ids) if cfg.brb_enabled else None
@@ -239,6 +297,27 @@ class Experiment:
             eligible = np.arange(self.cfg.num_peers)
         return np.sort(rng.choice(eligible, self.cfg.trainers_per_round, replace=False))
 
+    def _run_trust_plane(self, r: int, live: np.ndarray, delta) -> tuple:
+        """Digest each live trainer's on-device delta, BRB-broadcast the
+        commitments, account control traffic, and feed the failure detector
+        (both receiver failures and excluded senders enter cooldown).
+        Returns ``(delivered, failed, excluded, verified, msgs, nbytes)``."""
+        digests = {
+            int(t): digest_update(
+                jax.tree.map(lambda d, t=t: np.asarray(d[int(t)]), delta)
+            )
+            for t in live
+        }
+        m0, b0 = self.trust.hub.messages_sent, self.trust.hub.bytes_sent
+        delivered, failed, verified = self.trust.run_round(r, live.tolist(), digests)
+        excluded = sorted(set(live.tolist()) - set(verified))
+        msgs = self.trust.hub.messages_sent - m0
+        nbytes = self.trust.hub.bytes_sent - b0
+        if self.failure_cooldown_rounds > 0:
+            for pid in failed + excluded:
+                self._suspect_until[pid] = r + self.failure_cooldown_rounds
+        return delivered, failed, excluded, verified, msgs, nbytes
+
     def run_round(self, trainers: Optional[np.ndarray] = None) -> RoundRecord:
         """Run one round. ``trainers`` overrides role sampling (the Cluster
         facade passes the set its Nodes consented to, reference
@@ -257,37 +336,68 @@ class Experiment:
         # sample_roles); the device program consumes the padded vector, the
         # host plane (trust, metrics, records) only the live peers.
         live = trainers[trainers >= 0]
+        mask_key = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), r)
         t0 = time.perf_counter()
-        with self.profiler.phase("round"):
-            self.state, m = self.round_fn(
-                self.state,
-                self.x,
-                self.y,
-                jnp.asarray(trainers, jnp.int32),
-                self.byz_gate,
-                jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), r),
-            )
-            # Mean over this round's trainers only — non-trainers' local
-            # losses exist on-device but the reference's progress metric is
-            # trainer loss (``main.py:90-94`` collects from trainer runs).
-            # Gossip has no roles: every peer trains, so every loss counts.
-            losses = np.asarray(m["train_loss"])
-            if self.cfg.aggregator != "gossip":
-                losses = losses[live]
-            train_loss = float(np.mean(losses))
-
-        brb_delivered = brb_failed = msgs = nbytes = None
-        if self.trust is not None:
+        brb_delivered = brb_failed = brb_excluded = msgs = nbytes = None
+        if self._gated:
+            # BRB-gated pipeline: train -> digest+BRB -> gated aggregate.
+            with self.profiler.phase("round"):
+                delta, new_opt, losses_dev = self.train_fn(
+                    self.state, self.x, self.y, self.byz_gate, mask_key
+                )
+                losses = np.asarray(losses_dev)[live]
+                train_loss = float(np.mean(losses))
             with self.profiler.phase("brb"):
-                fingerprints = np.asarray(m["fingerprint"])
-                m0, b0 = self.trust.hub.messages_sent, self.trust.hub.bytes_sent
-                delivered, failed = self.trust.run_round(r, live.tolist(), fingerprints)
-                brb_delivered, brb_failed = delivered, failed
-                msgs = self.trust.hub.messages_sent - m0
-                nbytes = self.trust.hub.bytes_sent - b0
-                if self.failure_cooldown_rounds > 0:
-                    for pid in failed:
-                        self._suspect_until[pid] = r + self.failure_cooldown_rounds
+                brb_delivered, brb_failed, brb_excluded, verified, msgs, nbytes = (
+                    self._run_trust_plane(r, live, delta)
+                )
+                if self.cfg.aggregator in ("fedavg", "secure_fedavg"):
+                    # Gate: a trainer whose commitment did not deliver+verify
+                    # contributes nothing to THIS round's aggregate (the -1
+                    # vacancy mechanism; no recompile). This is the
+                    # reference's aggregate-only-delivered-verified semantic
+                    # (reference ``node/node.py:130-145``,
+                    # ``aggregator/aggregation.py:8-28``).
+                    gated = np.where(np.isin(trainers, verified), trainers, -1)
+                else:
+                    # Gathered robust reducers need their full [T] update
+                    # matrix and are content-robust in-band (tolerate f
+                    # Byzantine updates by construction); delivery failures
+                    # remain observational -> next-round sampling exclusion.
+                    gated = trainers
+            with self.profiler.phase("agg"):
+                self.state = self.agg_fn(
+                    self.state, delta, new_opt, jnp.asarray(gated, jnp.int32), mask_key
+                )
+        else:
+            with self.profiler.phase("round"):
+                self.state, m = self.round_fn(
+                    self.state,
+                    self.x,
+                    self.y,
+                    jnp.asarray(trainers, jnp.int32),
+                    self.byz_gate,
+                    mask_key,
+                )
+                # Mean over this round's trainers only — non-trainers' local
+                # losses exist on-device but the reference's progress metric
+                # is trainer loss (``main.py:90-94`` collects from trainer
+                # runs). Gossip has no roles: every peer trains, so every
+                # loss counts.
+                losses = np.asarray(m["train_loss"])
+                if self.cfg.aggregator != "gossip":
+                    losses = losses[live]
+                train_loss = float(np.mean(losses))
+
+            if self.trust is not None:
+                # Gossip with the trust plane: the ring mix is in-band, so
+                # BRB here is observational — each peer commits to its own
+                # PRE-mix delta (what it contributed to the ring); delivery
+                # accounting feeds next-round cooldown exclusion.
+                with self.profiler.phase("brb"):
+                    brb_delivered, brb_failed, brb_excluded, _, msgs, nbytes = (
+                        self._run_trust_plane(r, live, m["delta"])
+                    )
 
         with self.profiler.phase("eval"):
             ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)
@@ -300,6 +410,7 @@ class Experiment:
             duration_s=time.perf_counter() - t0,
             brb_delivered=brb_delivered,
             brb_failed_peers=brb_failed,
+            brb_excluded_trainers=brb_excluded,
             control_messages=msgs,
             control_bytes=nbytes,
         )

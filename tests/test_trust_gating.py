@@ -1,0 +1,197 @@
+"""BRB gates the aggregate: only delivered, digest-verified updates are
+admitted.
+
+This is the reference's core security semantic — a tester accumulates
+exactly the updates it received and signature-verified (reference
+``node/node.py:130-145`` feeds ``received_models``;
+``aggregator/aggregation.py:8-28`` consumes them) — realized here as the
+split (train / BRB / aggregate) round: the trust plane's verdict replaces
+unverified trainers with ``-1`` vacancies before the aggregate runs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.protocol.crypto import digest_update
+from p2pdl_tpu.runtime.driver import Experiment
+
+# float32 compute + general path (local_epochs=2) so split-vs-fused round
+# comparisons are exact up to float noise.
+CFG = Config(
+    num_peers=8,
+    trainers_per_round=3,
+    rounds=2,
+    local_epochs=2,
+    samples_per_peer=32,
+    batch_size=32,
+    lr=0.05,
+    server_lr=1.0,
+    compute_dtype="float32",
+    byzantine_f=2,
+)
+
+TRAINERS = [1, 3, 6]
+
+
+def _params_after_round(cfg, trainers, mesh8, **kwargs):
+    exp = Experiment(cfg, **kwargs)
+    record = exp.run_round(trainers=np.asarray(trainers))
+    return exp, record
+
+
+def _assert_trees_close(a, b, atol=1e-6):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=atol)
+
+
+def test_gated_round_matches_fused_when_all_verify(mesh8):
+    """With every broadcast delivering and verifying, the split (BRB-gated)
+    round must equal the fused no-trust round bit-for-bit — the gate is
+    pass-through, not a numerics change."""
+    exp_brb, rec = _params_after_round(CFG.replace(brb_enabled=True), TRAINERS, mesh8)
+    assert rec.brb_excluded_trainers == []
+    exp_plain, _ = _params_after_round(CFG, TRAINERS, mesh8)
+    _assert_trees_close(exp_brb.state.params, exp_plain.state.params)
+
+
+def test_failed_delivery_trainer_contributes_nothing(mesh8):
+    """A trainer whose broadcast never delivers (all its outbound control
+    messages dropped) is gated out: the aggregate equals the same round run
+    with that trainer replaced by a -1 vacancy — it contributes nothing."""
+    victim = 3
+    cfg = CFG.replace(brb_enabled=True)
+    exp = Experiment(cfg)
+    exp.trust.hub.drop = lambda src, dst, data: src == victim
+    record = exp.run_round(trainers=np.asarray(TRAINERS))
+    assert record.brb_excluded_trainers == [victim]
+    # Sender-side failure: the victim is the fault, not its receivers.
+    assert record.brb_failed_peers == []
+
+    expected, _ = _params_after_round(
+        CFG, [t if t != victim else -1 for t in TRAINERS], mesh8
+    )
+    _assert_trees_close(exp.state.params, expected.state.params)
+
+
+def test_equivocating_trainer_contributes_nothing(mesh8):
+    """An equivocating Byzantine trainer splits the echo vote, delivers
+    nothing, and is gated out of the aggregate."""
+    byz = 1
+    cfg = CFG.replace(brb_enabled=True)
+    exp = Experiment(cfg, byz_ids=(byz,))
+    record = exp.run_round(trainers=np.asarray(TRAINERS))
+    assert record.brb_excluded_trainers == [byz]
+
+    expected, _ = _params_after_round(
+        CFG, [t if t != byz else -1 for t in TRAINERS], mesh8, byz_ids=(byz,)
+    )
+    _assert_trees_close(exp.state.params, expected.state.params)
+
+
+def test_norm_collision_forgery_rejected(mesh8):
+    """The commitment binds update *content*, not norms. A forged commitment
+    with identical per-leaf squared norms (which the old norm-fingerprint
+    scheme could not distinguish) delivers consistently via BRB but fails
+    digest verification against the actual update — the liar is gated out."""
+    liar = 6
+    cfg = CFG.replace(brb_enabled=True)
+    exp = Experiment(cfg)
+
+    # Build a norm-preserving forgery of the liar's actual delta: negate
+    # every leaf (same squared norm per leaf, different content).
+    delta, _, _ = exp.train_fn(
+        exp.state,
+        exp.x,
+        exp.y,
+        exp.byz_gate,
+        jax.random.fold_in(jax.random.PRNGKey(cfg.seed), 0),
+    )
+    real = jax.tree.map(lambda d: np.asarray(d[liar]), delta)
+    forged = jax.tree.map(lambda d: -d, real)
+    for r, f in zip(jax.tree.leaves(real), jax.tree.leaves(forged)):
+        np.testing.assert_allclose(np.sum(r**2), np.sum(f**2), rtol=1e-6)
+    assert digest_update(real) != digest_update(forged)
+
+    exp.trust.lie_digests[liar] = digest_update(forged)
+    record = exp.run_round(trainers=np.asarray(TRAINERS))
+    assert record.brb_excluded_trainers == [liar]
+    # Full BRB delivery everywhere — the forgery is caught by content
+    # verification, not by delivery failure.
+    assert record.brb_delivered == cfg.num_peers
+
+    expected, _ = _params_after_round(
+        CFG, [t if t != liar else -1 for t in TRAINERS], mesh8
+    )
+    _assert_trees_close(exp.state.params, expected.state.params)
+
+
+def test_excluded_trainer_optimizer_state_does_not_advance(mesh8):
+    """A gated-out trainer must look exactly as if it was never sampled:
+    with momentum on, its optimizer state stays put."""
+    victim = 3
+    cfg = CFG.replace(brb_enabled=True, momentum=0.9)
+    exp = Experiment(cfg)
+    before = jax.tree.map(np.asarray, exp.state.opt_state)
+    exp.trust.hub.drop = lambda src, dst, data: src == victim
+    record = exp.run_round(trainers=np.asarray(TRAINERS))
+    assert record.brb_excluded_trainers == [victim]
+    after = exp.state.opt_state
+    for b, a in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        b, a = np.asarray(b), np.asarray(a)
+        if b.ndim == 0 or b.shape[0] != cfg.num_peers:
+            continue
+        np.testing.assert_array_equal(b[victim], a[victim])
+        # ... while a verified trainer's optimizer state did advance.
+        assert not np.array_equal(b[TRAINERS[0]], a[TRAINERS[0]])
+
+
+def test_sender_failure_triggers_cooldown_exclusion(mesh8):
+    """Failure detection composes with gating: a dead trainer (sender-side
+    failure) enters the cooldown table and is not sampled while suspect."""
+    victim = 3
+    cfg = CFG.replace(brb_enabled=True)
+    exp = Experiment(cfg, failure_cooldown_rounds=3)
+    exp.trust.hub.drop = lambda src, dst, data: src == victim
+    record = exp.run_round(trainers=np.asarray(TRAINERS))
+    assert record.brb_excluded_trainers == [victim]
+    for future in range(record.round + 1, record.round + 4):
+        assert victim not in exp.sample_roles(future)
+
+
+def test_gossip_sender_failure_enters_cooldown(mesh8):
+    """Gossip BRB is observational (the mix is in-band), but a dead sender
+    must still feed the failure detector and skip subsequent sampling."""
+    victim = 3
+    cfg = CFG.replace(brb_enabled=True, aggregator="gossip")
+    exp = Experiment(cfg, failure_cooldown_rounds=3)
+    exp.trust.hub.drop = lambda src, dst, data: src == victim
+    record = exp.run_round(trainers=np.asarray(TRAINERS))
+    assert victim in record.brb_excluded_trainers
+    for future in range(record.round + 1, record.round + 4):
+        assert victim not in exp.sample_roles(future)
+
+
+def test_digest_update_binds_content_not_norms():
+    """Unit: digest_update distinguishes trees the norm fingerprint cannot."""
+    a = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    b = {"w": -np.arange(6, dtype=np.float32).reshape(2, 3)}
+    c = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)[::-1].copy()}
+    assert digest_update(a) != digest_update(b)
+    assert digest_update(a) != digest_update(c)  # same values, permuted rows
+    assert digest_update(a) == digest_update({"w": a["w"].copy()})
+
+
+def test_robust_reducer_keeps_full_matrix_under_brb(mesh8):
+    """Gathered robust reducers are content-robust in-band: under BRB they
+    aggregate their full trainer matrix (no -1 gating) and delivery failures
+    surface observationally."""
+    cfg = CFG.replace(
+        brb_enabled=True, aggregator="krum", trainers_per_round=8, byzantine_f=1
+    )
+    exp = Experiment(cfg)
+    record = exp.run_round()
+    assert record.brb_excluded_trainers == []
+    assert np.isfinite(record.train_loss)
