@@ -15,11 +15,11 @@ per-client shards with a fixed seed, reference ``datasets/dataset.py:21-62``)
   *training* shard (reference ``evaluation/evaluation.py:10``), a bug we fix
   deliberately.
 
-This environment has no dataset files and no network egress, so the default
-generators are deterministic synthetic tasks with real learnable structure
-(class-conditional images, Markov-chain text) matching the real datasets'
-shapes and vocabularies exactly; loaders accept drop-in real arrays when
-present.
+- REAL MNIST / CIFAR-10 load from disk when present (``p2pdl_tpu.data.real``
+  parses the IDX / CIFAR-binary formats with NumPy — no torchvision, no
+  egress) and fall back to deterministic synthetic tasks with real learnable
+  structure (class-conditional images, Markov-chain text) matching the real
+  datasets' shapes and vocabularies exactly.
 """
 
 from __future__ import annotations
@@ -34,10 +34,13 @@ from p2pdl_tpu.data.partition import (
     sample_labels,
 )
 from p2pdl_tpu.data.federated import FederatedData, make_federated_data
+from p2pdl_tpu.data.real import load_raw, partition_indices
 
 __all__ = [
     "FederatedData",
     "make_federated_data",
+    "load_raw",
+    "partition_indices",
     "class_conditional_images",
     "markov_text",
     "dirichlet_label_proportions",

@@ -12,6 +12,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from p2pdl_tpu.config import Config
 from p2pdl_tpu.data import partition as part
@@ -42,6 +43,8 @@ class FederatedData:
     eval_x: jnp.ndarray
     eval_y: jnp.ndarray
     num_classes: int
+    # "real" (loaded from disk, p2pdl_tpu.data.real) or "synthetic".
+    source: str = "synthetic"
 
     @property
     def num_peers(self) -> int:
@@ -50,6 +53,33 @@ class FederatedData:
     @property
     def samples_per_peer(self) -> int:
         return self.x.shape[1]
+
+
+def _from_raw(cfg: Config, raw, eval_samples: int) -> FederatedData:
+    """Peer-stack a loaded real dataset: index partition over the train
+    split, held-out eval drawn from the TEST split (the reference evaluates
+    on training shards, ``evaluation/evaluation.py:10`` — a documented fix)."""
+    from p2pdl_tpu.data import real
+
+    idx = real.partition_indices(
+        raw.train_y,
+        cfg.num_peers,
+        cfg.samples_per_peer,
+        cfg.partition,
+        cfg.dirichlet_alpha,
+        cfg.seed,
+    )
+    rng = np.random.default_rng([cfg.seed, 7])
+    n_test = len(raw.test_y)
+    eidx = rng.permutation(n_test)[: min(eval_samples, n_test)]
+    return FederatedData(
+        x=jnp.asarray(raw.train_x[idx]),
+        y=jnp.asarray(raw.train_y[idx]),
+        eval_x=jnp.asarray(raw.test_x[eidx]),
+        eval_y=jnp.asarray(raw.test_y[eidx]),
+        num_classes=NUM_CLASSES,
+        source="real",
+    )
 
 
 def _label_proportions(cfg: Config, key: jax.Array, num_classes: int) -> jnp.ndarray:
@@ -61,12 +91,24 @@ def _label_proportions(cfg: Config, key: jax.Array, num_classes: int) -> jnp.nda
 def make_federated_data(cfg: Config, key: jax.Array | None = None, eval_samples: int = 1024) -> FederatedData:
     """Build the peer-stacked dataset named by ``cfg.dataset``.
 
-    Deterministic in ``cfg.seed`` (the reference pins its split with
-    ``torch.manual_seed(42)`` at ``datasets/dataset.py:30``; here the full
-    generation + partition is keyed).
+    For ``mnist``/``cifar10``, the REAL dataset is loaded from disk when its
+    files are present (reference ``datasets/dataset.py:21-51`` downloads via
+    torchvision; this environment has no egress, so files are found, never
+    fetched — see ``p2pdl_tpu.data.real``) and partitioned IID or
+    Dirichlet; otherwise the deterministic synthetic stand-in is generated.
+    Deterministic in ``cfg.seed`` either way (the reference pins its split
+    with ``torch.manual_seed(42)`` at ``datasets/dataset.py:30``; here the
+    full generation + partition is keyed).
     """
     if key is None:
         key = jax.random.PRNGKey(cfg.seed)
+
+    if cfg.dataset in ("mnist", "cifar10"):
+        from p2pdl_tpu.data import real
+
+        raw = real.load_raw(cfg.dataset)
+        if raw is not None:
+            return _from_raw(cfg, raw, eval_samples)
 
     if cfg.dataset == "shakespeare":
         trans_key, text_key, eval_key = jax.random.split(key, 3)

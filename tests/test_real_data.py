@@ -1,0 +1,185 @@
+"""Real-dataset ingestion: IDX/CIFAR-binary parsing, partitioning, fallback.
+
+The reference downloads MNIST/CIFAR-10 via torchvision (reference
+``datasets/dataset.py:21-51``); here the same datasets load from disk with
+NumPy only. These tests fabricate tiny valid dataset files and point the
+loader at them via ``P2PDL_DATA_DIR``.
+"""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.data import make_federated_data
+from p2pdl_tpu.data import real
+
+
+def _write_idx_images(path: str, images: np.ndarray, gz: bool = False) -> None:
+    n, h, w = images.shape
+    header = struct.pack(">HBB", 0, 0x08, 3) + struct.pack(">3I", n, h, w)
+    payload = header + images.astype(np.uint8).tobytes()
+    if gz:
+        with gzip.open(path + ".gz", "wb") as f:
+            f.write(payload)
+    else:
+        with open(path, "wb") as f:
+            f.write(payload)
+
+
+def _write_idx_labels(path: str, labels: np.ndarray, gz: bool = False) -> None:
+    header = struct.pack(">HBB", 0, 0x08, 1) + struct.pack(">I", len(labels))
+    payload = header + labels.astype(np.uint8).tobytes()
+    if gz:
+        with gzip.open(path + ".gz", "wb") as f:
+            f.write(payload)
+    else:
+        with open(path, "wb") as f:
+            f.write(payload)
+
+
+@pytest.fixture
+def mnist_dir(tmp_path):
+    rng = np.random.default_rng(0)
+    d = tmp_path / "mnist"
+    d.mkdir()
+    train_y = rng.integers(0, 10, 256).astype(np.uint8)
+    test_y = rng.integers(0, 10, 64).astype(np.uint8)
+    # Make pixel content label-dependent so learnability is plausible.
+    train_x = (train_y[:, None, None] * 20 + rng.integers(0, 20, (256, 28, 28))).astype(np.uint8)
+    test_x = (test_y[:, None, None] * 20 + rng.integers(0, 20, (64, 28, 28))).astype(np.uint8)
+    _write_idx_images(str(d / "train-images-idx3-ubyte"), train_x)
+    _write_idx_labels(str(d / "train-labels-idx1-ubyte"), train_y)
+    # Mix plain and gzipped files — both must parse.
+    _write_idx_images(str(d / "t10k-images-idx3-ubyte"), test_x, gz=True)
+    _write_idx_labels(str(d / "t10k-labels-idx1-ubyte"), test_y, gz=True)
+    return tmp_path, train_y, test_y
+
+
+@pytest.fixture
+def cifar_dir(tmp_path):
+    rng = np.random.default_rng(1)
+    d = tmp_path / "cifar-10-batches-bin"
+    d.mkdir()
+
+    def batch(n, seed):
+        r = np.random.default_rng(seed)
+        labels = r.integers(0, 10, n, dtype=np.uint8)[:, None]
+        pixels = r.integers(0, 256, (n, 3072), dtype=np.uint8)
+        return np.concatenate([labels, pixels], axis=1)
+
+    for i in range(1, 6):
+        batch(40, i).tofile(str(d / f"data_batch_{i}.bin"))
+    batch(30, 99).tofile(str(d / "test_batch.bin"))
+    return tmp_path
+
+
+def test_mnist_idx_roundtrip(mnist_dir, monkeypatch):
+    root, train_y, test_y = mnist_dir
+    monkeypatch.setenv(real.DATA_DIR_ENV, str(root))
+    raw = real.load_raw("mnist")
+    assert raw is not None
+    assert raw.train_x.shape == (256, 28, 28, 1)
+    assert raw.test_x.shape == (64, 28, 28, 1)
+    np.testing.assert_array_equal(raw.train_y, train_y.astype(np.int32))
+    np.testing.assert_array_equal(raw.test_y, test_y.astype(np.int32))
+    # Reference normalization: [-1, 1] (datasets/dataset.py:6,22).
+    assert raw.train_x.min() >= -1.0 and raw.train_x.max() <= 1.0
+    assert raw.train_x.dtype == np.float32
+
+
+def test_cifar_bin_roundtrip(cifar_dir, monkeypatch):
+    monkeypatch.setenv(real.DATA_DIR_ENV, str(cifar_dir))
+    raw = real.load_raw("cifar10")
+    assert raw is not None
+    assert raw.train_x.shape == (200, 32, 32, 3)
+    assert raw.test_x.shape == (30, 32, 32, 3)
+    assert raw.train_y.shape == (200,)
+    assert set(np.unique(raw.train_y)) <= set(range(10))
+
+
+def test_federated_data_uses_real_when_present(mnist_dir, monkeypatch):
+    root, _, _ = mnist_dir
+    monkeypatch.setenv(real.DATA_DIR_ENV, str(root))
+    cfg = Config(num_peers=8, trainers_per_round=3, samples_per_peer=16, batch_size=8)
+    data = make_federated_data(cfg, eval_samples=32)
+    assert data.source == "real"
+    assert data.x.shape == (8, 16, 28, 28, 1)
+    assert data.y.shape == (8, 16)
+    assert data.eval_x.shape == (32, 28, 28, 1)
+    # Deterministic in the seed.
+    again = make_federated_data(cfg, eval_samples=32)
+    np.testing.assert_array_equal(np.asarray(data.x), np.asarray(again.x))
+    other = make_federated_data(cfg.replace(seed=7), eval_samples=32)
+    assert not np.array_equal(np.asarray(data.x), np.asarray(other.x))
+
+
+def test_fallback_to_synthetic_when_absent(tmp_path, monkeypatch):
+    monkeypatch.setenv(real.DATA_DIR_ENV, str(tmp_path / "empty"))
+    monkeypatch.chdir(tmp_path)
+    cfg = Config(num_peers=8, trainers_per_round=3, samples_per_peer=16, batch_size=8)
+    data = make_federated_data(cfg, eval_samples=32)
+    assert data.source == "synthetic"
+    assert data.x.shape == (8, 16, 28, 28, 1)
+
+
+def test_partial_cifar_dir_not_loaded(tmp_path, monkeypatch):
+    """An incomplete dataset dir (missing batches) must not count as real
+    data — no silent fraction-of-CIFAR training, no mid-parse crash."""
+    d = tmp_path / "cifar-10-batches-bin"
+    d.mkdir()
+    rng = np.random.default_rng(0)
+    rec = np.concatenate(
+        [rng.integers(0, 10, (5, 1), dtype=np.uint8),
+         rng.integers(0, 256, (5, 3072), dtype=np.uint8)], axis=1
+    )
+    rec.tofile(str(d / "data_batch_1.bin"))  # only 1 of 5 + no test batch
+    monkeypatch.setenv(real.DATA_DIR_ENV, str(tmp_path))
+    assert real.load_raw("cifar10") is None
+
+
+def test_iid_partition_matches_random_split_semantics():
+    """IID = seeded shuffle cut into equal shards (reference
+    ``datasets/dataset.py:25-33``): shards are disjoint while supply lasts."""
+    labels = np.random.default_rng(0).integers(0, 10, 200).astype(np.int32)
+    idx = real.partition_indices(labels, 8, 16, "iid", 0.5, seed=42)
+    assert idx.shape == (8, 16)
+    flat = idx.ravel()
+    assert len(np.unique(flat)) == len(flat)  # 128 <= 200: no replacement
+    # Deterministic.
+    again = real.partition_indices(labels, 8, 16, "iid", 0.5, seed=42)
+    np.testing.assert_array_equal(idx, again)
+
+
+def test_iid_partition_wraps_when_oversubscribed():
+    labels = np.zeros(50, np.int32)
+    idx = real.partition_indices(labels, 8, 16, "iid", 0.5, seed=0)
+    assert idx.shape == (8, 16)
+    assert idx.max() < 50
+
+
+def test_dirichlet_partition_skews_labels():
+    """Dirichlet(0.1) must produce visibly non-uniform per-peer label
+    histograms; each peer's samples come from its index row."""
+    rng = np.random.default_rng(3)
+    labels = rng.integers(0, 10, 1000).astype(np.int32)
+    idx = real.partition_indices(labels, 8, 64, "dirichlet", 0.1, seed=42)
+    assert idx.shape == (8, 64)
+    maxima = []
+    for p in range(8):
+        counts = np.bincount(labels[idx[p]], minlength=10)
+        maxima.append(counts.max() / counts.sum())
+    # At alpha=0.1 most peers are dominated by a few classes; uniform would
+    # give ~0.1 per class.
+    assert np.mean(maxima) > 0.35
+
+
+def test_corrupt_idx_rejected(tmp_path):
+    p = tmp_path / "bad"
+    p.write_bytes(b"\x00\x01\x02\x03garbage")
+    with open(p, "rb") as f:
+        with pytest.raises(ValueError, match="IDX"):
+            real._read_idx(f)
