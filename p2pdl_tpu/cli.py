@@ -39,6 +39,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--aggregator", choices=AGGREGATORS, default="fedavg")
     p.add_argument("--trimmed-mean-beta", type=float, default=0.1)
     p.add_argument("--multi-krum-m", type=int, default=0)
+    p.add_argument(
+        "--robust-impl",
+        choices=["blockwise", "gathered"],
+        default="blockwise",
+        help="robust-reducer strategy: blockwise streams O(peers x block) "
+        "transients; gathered all-gathers the full update stack",
+    )
     p.add_argument("--brb", action="store_true", help="enable the BRB trust plane")
     p.add_argument("--round-timeout-s", type=float, default=30.0)
     p.add_argument("--seed", type=int, default=42)
@@ -98,6 +105,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         aggregator=args.aggregator,
         trimmed_mean_beta=args.trimmed_mean_beta,
         multi_krum_m=args.multi_krum_m,
+        robust_impl=args.robust_impl,
         brb_enabled=args.brb,
         round_timeout_s=args.round_timeout_s,
         seed=args.seed,

@@ -71,6 +71,12 @@ class Config:
     aggregator: str = "fedavg"
     trimmed_mean_beta: float = 0.1  # fraction trimmed from each tail
     multi_krum_m: int = 0  # 0 => n_trainers - f - 2 selected
+    # Robust-reducer execution strategy: "blockwise" streams the peer axis
+    # through fixed-size feature blocks (O(peers x block) transient HBM —
+    # scales to 1024 peers on real models); "gathered" all-gathers the full
+    # update stack (O(peers x model) per device — simple, fine at small
+    # scale, kept as the equivalence oracle).
+    robust_impl: str = "blockwise"
 
     # Trust plane (read by the host-side round driver/protocol layer; the
     # compiled round function itself is trust-agnostic).
@@ -112,6 +118,10 @@ class Config:
             raise ValueError(
                 f"attn_impl='flash' requires an attention model (vit_tiny); "
                 f"model={self.model!r} has no attention"
+            )
+        if self.robust_impl not in ("blockwise", "gathered"):
+            raise ValueError(
+                f"unknown robust_impl {self.robust_impl!r}; one of ('blockwise', 'gathered')"
             )
         if not (0.0 <= self.trimmed_mean_beta < 0.5):
             raise ValueError(f"trimmed_mean_beta must be in [0, 0.5), got {self.trimmed_mean_beta}")

@@ -47,7 +47,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from p2pdl_tpu.config import Config
-from p2pdl_tpu.ops import aggregators
+from p2pdl_tpu.ops import aggregators, sharded_aggregators
 from p2pdl_tpu.ops.attacks import apply_attack
 from p2pdl_tpu.ops.gossip import ring_mix
 from p2pdl_tpu.ops.secure_agg import apply_masks
@@ -141,6 +141,24 @@ def _aggregate(cfg: Config, deltas_trainers: Any) -> Any:
     if cfg.aggregator == "median":
         return aggregators.median(deltas_trainers)
     raise ValueError(f"no gathered-reducer for {cfg.aggregator!r}")
+
+
+def _aggregate_blockwise(cfg: Config, delta: Any, trainer_idx) -> Any:
+    """Dispatch to the blockwise (streamed) reducer over local ``[L, ...]``
+    delta blocks inside ``shard_map`` (``ops.sharded_aggregators``)."""
+    if cfg.aggregator == "krum":
+        return sharded_aggregators.krum_sharded(delta, trainer_idx, cfg.byzantine_f)
+    if cfg.aggregator == "multi_krum":
+        return sharded_aggregators.multi_krum_sharded(
+            delta, trainer_idx, cfg.byzantine_f, cfg.multi_krum_m
+        )
+    if cfg.aggregator == "trimmed_mean":
+        return sharded_aggregators.trimmed_mean_sharded(
+            delta, trainer_idx, cfg.trimmed_mean_beta
+        )
+    if cfg.aggregator == "median":
+        return sharded_aggregators.median_sharded(delta, trainer_idx)
+    raise ValueError(f"no blockwise reducer for {cfg.aggregator!r}")
 
 
 def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
@@ -443,6 +461,12 @@ def _aggregate_phase(cfg, l_per_dev):
                 return lax.psum(jnp.sum(d * w, axis=0), PEER_AXIS) / count.astype(d.dtype)
 
             agg = jax.tree.map(leaf, delta)
+        elif cfg.robust_impl == "blockwise":
+            # Stream the peer axis through feature blocks: O(P x block)
+            # transient instead of O(P x model) per device (SURVEY §7 hard
+            # part (b)) — the 1024-peer-capable path. Results are already
+            # replicated (masked-psum extraction / psum-selected vector).
+            agg = _aggregate_blockwise(cfg, delta, trainer_idx)
         else:
             # Robust reducers need every trainer's update visible everywhere.
             all_d = jax.tree.map(
