@@ -11,10 +11,21 @@ delta computation + aggregation + global sync — the complete data-plane work
 of the reference's train/exchange/aggregate/broadcast cycle (reference
 ``main.py:50-84``), executing as one compiled program.
 
-Default invocation (the driver contract) prints exactly ONE JSON line for
-the headline config: {"metric", "value", "unit", "vs_baseline"}.
-``python bench.py --matrix`` additionally runs the full BASELINE.md matrix,
-printing one JSON line per config and writing ``BENCH_MATRIX.json``.
+Robustness (the TPU backend in this environment can flake with UNAVAILABLE
+at session start): every timed config runs under retry-with-backoff, the
+headline runs as STAGED sizes (8 -> 128 -> 1024 peers) with each stage
+written to ``BENCH_STAGES.json`` as it lands, and failures are recorded as
+structured error entries instead of crashing the run.
+
+Modes:
+- default: staged headline; stdout carries exactly ONE final JSON line
+  (the driver contract) — stage progress goes to stderr.
+- ``--matrix``: the full BASELINE.md matrix (+ 1024-peer blockwise Krum and
+  the fused-vs-dense attention microbench), one JSON line per entry,
+  written incrementally to ``BENCH_MATRIX.json``.
+- ``--time-to-acc [TARGET]``: CIFAR-10 time-to-accuracy (default 0.70),
+  real dataset when present on disk, synthetic stand-in otherwise (the
+  record carries ``dataset_source`` so nobody mistakes which one ran).
 """
 
 from __future__ import annotations
@@ -22,6 +33,7 @@ from __future__ import annotations
 import json
 import sys
 import time
+import traceback
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +42,7 @@ import numpy as np
 from p2pdl_tpu.config import Config
 from p2pdl_tpu.data import make_federated_data
 from p2pdl_tpu.parallel import (
+    build_eval_fn,
     build_round_fn,
     init_peer_state,
     make_mesh,
@@ -38,6 +51,40 @@ from p2pdl_tpu.parallel import (
 )
 
 NORTH_STAR_ROUNDS_PER_SEC = 50.0
+STAGES_PATH = "BENCH_STAGES.json"
+MATRIX_PATH = "BENCH_MATRIX.json"
+
+# Transient backend failures worth retrying (the axon TPU tunnel can report
+# UNAVAILABLE for a while after session start).
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED", "backend")
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _with_retry(fn, name: str, attempts: int = 3, backoff_s: float = 15.0):
+    """Run ``fn`` with backoff; returns (value, error_record_or_None)."""
+    last = None
+    for i in range(1, attempts + 1):
+        try:
+            return fn(), None
+        except Exception as e:  # noqa: BLE001 - benchmark must not crash
+            last = {
+                "metric": name,
+                "error": f"{type(e).__name__}: {e}"[:500],
+                "attempt": i,
+                "transient": any(m in str(e) for m in _TRANSIENT_MARKERS),
+            }
+            _log(f"[bench] {name} attempt {i}/{attempts} failed: {last['error'][:200]}")
+            traceback.print_exc(file=sys.stderr)
+            if not last["transient"]:
+                # Deterministic failures (config errors, OOM at trace time)
+                # won't heal with retries — don't burn backoff sleeps.
+                break
+            if i < attempts:
+                time.sleep(backoff_s * i)
+    return None, last
 
 
 def bench_config(
@@ -78,11 +125,6 @@ def bench_config(
     return timed_rounds / dt
 
 
-def bench_rounds_per_sec(num_peers: int = 1024, timed_rounds: int = 20) -> float:
-    """Headline metric: 1024-peer MLP FedAvg rounds/sec."""
-    return bench_config(_headline_cfg(num_peers), timed_rounds=timed_rounds)
-
-
 def _headline_cfg(num_peers: int = 1024) -> Config:
     return Config(
         num_peers=num_peers,
@@ -95,8 +137,60 @@ def _headline_cfg(num_peers: int = 1024) -> Config:
     )
 
 
+def bench_rounds_per_sec(num_peers: int = 1024, timed_rounds: int = 20) -> float:
+    """Headline metric: 1024-peer MLP FedAvg rounds/sec."""
+    return bench_config(_headline_cfg(num_peers), timed_rounds=timed_rounds)
+
+
+def run_staged_headline() -> dict:
+    """8 -> 128 -> 1024 peers, each written to BENCH_STAGES.json as it
+    lands; returns the headline record (largest successful stage)."""
+    stages: list[dict] = []
+    best = None
+    for peers in (8, 128, 1024):
+        name = f"agg_rounds_per_sec_{peers}peers_mlp"
+        value, err = _with_retry(lambda p=peers: bench_rounds_per_sec(p), name)
+        rec = (
+            {"metric": name, "value": round(value, 3), "unit": "rounds/sec"}
+            if value is not None
+            else err
+        )
+        stages.append(rec)
+        with open(STAGES_PATH, "w") as f:
+            json.dump(stages, f, indent=1)
+        if value is not None:
+            best = {"peers": peers, "value": value}
+            _log(f"[bench] stage {peers} peers: {value:.1f} rounds/sec")
+    if best is None:
+        return {
+            "metric": "agg_rounds_per_sec_1024peers_mlp",
+            "value": 0.0,
+            "unit": "rounds/sec",
+            "vs_baseline": 0.0,
+            "error": "all staged sizes failed; see BENCH_STAGES.json",
+        }
+    rec = {
+        "metric": f"agg_rounds_per_sec_{best['peers']}peers_mlp",
+        "value": round(best["value"], 3),
+        "unit": "rounds/sec",
+    }
+    if best["peers"] == 1024:
+        rec["vs_baseline"] = round(best["value"] / NORTH_STAR_ROUNDS_PER_SEC, 3)
+    else:
+        # The north star is defined AT 1024 peers; a smaller fallback stage
+        # must not claim a ratio against it (an 8-peer round does ~128x less
+        # work per round).
+        rec["vs_baseline"] = None
+        rec["note"] = (
+            f"1024-peer stage failed; value is the {best['peers']}-peer "
+            f"fallback — not comparable to the 1024-peer north star"
+        )
+    return rec
+
+
 def matrix_entries() -> list[dict]:
-    """The BASELINE.md config matrix (BASELINE.json "configs")."""
+    """The BASELINE.md config matrix (BASELINE.json "configs") plus the
+    1024-peer blockwise-Krum scaling entry (SURVEY §7 hard part (b))."""
     return [
         {
             "name": "mnist_mlp_8peers_fedavg",
@@ -139,44 +233,168 @@ def matrix_entries() -> list[dict]:
                 dataset="cifar10", aggregator="secure_fedavg",
             ),
         },
+        {
+            "name": "cifar10_cnn_1024peers_krum_blockwise",
+            "cfg": Config(
+                num_peers=1024, trainers_per_round=64, local_epochs=1,
+                samples_per_peer=8, batch_size=8, model="simple_cnn",
+                dataset="cifar10", aggregator="krum", byzantine_f=13,
+                robust_impl="blockwise",
+            ),
+        },
     ]
 
 
+def bench_attention(seq_len: int, impl: str, iters: int = 20) -> float:
+    """Milliseconds per fwd+bwd of one attention layer at ``seq_len``."""
+    from p2pdl_tpu.ops.attention import sdpa
+    from p2pdl_tpu.ops.pallas_attention import flash_attention
+
+    b, h, d = 1, 4, 64
+    key = jax.random.PRNGKey(0)
+    q, k, v = (
+        jax.random.normal(kk, (b, h, seq_len, d), jnp.bfloat16)
+        for kk in jax.random.split(key, 3)
+    )
+    fn = flash_attention if impl == "flash" else sdpa
+
+    def loss(q, k, v):
+        return jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    jax.block_until_ready(grad(q, k, v))  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = grad(q, k, v)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1000.0
+
+
 def run_matrix(timed_rounds: int = 10) -> list[dict]:
-    results = []
+    results: list[dict] = []
+
+    def flush() -> None:
+        with open(MATRIX_PATH, "w") as f:
+            json.dump(results, f, indent=1)
+
     for entry in matrix_entries():
-        value = bench_config(
-            entry["cfg"],
-            attack=entry.get("attack", "none"),
-            byz_ids=entry.get("byz_ids", ()),
-            timed_rounds=timed_rounds,
+        name = f"agg_rounds_per_sec_{entry['name']}"
+        value, err = _with_retry(
+            lambda e=entry: bench_config(
+                e["cfg"],
+                attack=e.get("attack", "none"),
+                byz_ids=e.get("byz_ids", ()),
+                timed_rounds=timed_rounds,
+            ),
+            name,
         )
-        rec = {
-            "metric": f"agg_rounds_per_sec_{entry['name']}",
-            "value": round(value, 3),
-            "unit": "rounds/sec",
-        }
+        rec = (
+            {"metric": name, "value": round(value, 3), "unit": "rounds/sec"}
+            if value is not None
+            else err
+        )
         print(json.dumps(rec), flush=True)
         results.append(rec)
+        flush()
+
+    # Fused (Pallas) vs dense attention, fwd+bwd. Off-TPU the fused kernel
+    # auto-routes to dense, so the ratio is only meaningful on TPU — the
+    # record carries the platform.
+    platform = jax.default_backend()
+    for seq_len in (1024, 4096):
+        name = f"attn_fwdbwd_ms_T{seq_len}"
+        timing, err = _with_retry(
+            lambda t=seq_len: {
+                "dense_ms": round(bench_attention(t, "dense"), 3),
+                "flash_ms": round(bench_attention(t, "flash"), 3),
+            },
+            name,
+        )
+        if timing is not None:
+            rec = {
+                "metric": name,
+                **timing,
+                "speedup": round(timing["dense_ms"] / max(timing["flash_ms"], 1e-9), 3),
+                "unit": "ms",
+                "platform": platform,
+            }
+        else:
+            rec = err
+        print(json.dumps(rec), flush=True)
+        results.append(rec)
+        flush()
     return results
 
 
-def main() -> None:
-    if "--matrix" in sys.argv:
-        results = run_matrix()
-        with open("BENCH_MATRIX.json", "w") as f:
-            json.dump(results, f, indent=1)
-    value = bench_rounds_per_sec()
-    print(
-        json.dumps(
-            {
-                "metric": "agg_rounds_per_sec_1024peers_mlp",
-                "value": round(value, 3),
-                "unit": "rounds/sec",
-                "vs_baseline": round(value / NORTH_STAR_ROUNDS_PER_SEC, 3),
-            }
-        )
+def run_time_to_acc(target: float = 0.70, max_rounds: int = 200) -> dict:
+    """CIFAR-10 time-to-accuracy: wall seconds of training (compile
+    excluded) until held-out accuracy reaches ``target``."""
+    cfg = Config(
+        num_peers=32, trainers_per_round=16, local_epochs=1,
+        samples_per_peer=256, batch_size=64, lr=0.05, server_lr=1.0,
+        model="simple_cnn", dataset="cifar10",
     )
+    mesh = make_mesh()
+    data = make_federated_data(cfg, eval_samples=1024)
+    state = shard_state(init_peer_state(cfg), cfg, mesh)
+    sh = peer_sharding(mesh)
+    x = jax.device_put(data.x, sh)
+    y = jax.device_put(data.y, sh)
+    round_fn = build_round_fn(cfg, mesh)
+    eval_fn = build_eval_fn(cfg)
+    rng = np.random.default_rng(cfg.seed)
+    byz = jnp.zeros(cfg.num_peers)
+
+    def one_round(state, r):
+        tid = jnp.asarray(
+            np.sort(rng.choice(cfg.num_peers, cfg.trainers_per_round, replace=False)),
+            jnp.int32,
+        )
+        state, m = round_fn(state, x, y, tid, byz, jax.random.PRNGKey(r))
+        return state, m
+
+    # Compile excluded from the clock (cached for every later round).
+    state, m = one_round(state, 0)
+    jax.block_until_ready(m["train_loss"])
+    ev = eval_fn(state, data.eval_x, data.eval_y)
+    acc = float(ev["eval_acc"])
+
+    t0 = time.perf_counter()
+    rounds = 1
+    while acc < target and rounds < max_rounds:
+        state, m = one_round(state, rounds)
+        rounds += 1
+        if rounds % 5 == 0 or rounds < 10:
+            acc = float(eval_fn(state, data.eval_x, data.eval_y)["eval_acc"])
+    dt = time.perf_counter() - t0
+    return {
+        "metric": f"cifar10_time_to_{int(target * 100)}pct_acc",
+        "value": round(dt, 3),
+        "unit": "seconds",
+        "rounds": rounds,
+        "final_acc": round(acc, 4),
+        "reached": acc >= target,
+        "dataset_source": data.source,
+        "platform": jax.default_backend(),
+    }
+
+
+def main() -> None:
+    if "--time-to-acc" in sys.argv:
+        i = sys.argv.index("--time-to-acc")
+        target = 0.70
+        if len(sys.argv) > i + 1:
+            try:
+                target = float(sys.argv[i + 1])
+            except ValueError:
+                pass
+        rec, err = _with_retry(lambda: run_time_to_acc(target), "time_to_acc")
+        print(json.dumps(rec if rec is not None else err))
+        return
+    if "--matrix" in sys.argv:
+        run_matrix()
+        return
+    print(json.dumps(run_staged_headline()))
 
 
 if __name__ == "__main__":
