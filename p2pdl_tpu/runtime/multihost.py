@@ -26,8 +26,12 @@ launch, so the same experiment script works in all three deployments
 
 from __future__ import annotations
 
+import base64
 import dataclasses
+import json
 import os
+import queue
+import time
 from typing import Optional
 
 import jax
@@ -82,6 +86,14 @@ def initialize(
             f"{NUM_PROCESSES_ENV} (>1), or neither"
         )
     if coordinator:
+        if num_processes > 1:
+            # Cross-process collectives on the CPU backend need gloo (the
+            # default CPU collective impl cannot span processes). No-op on
+            # TPU, where ICI/DCN collectives are native.
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:  # pragma: no cover - older jax without the knob
+                pass
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
@@ -174,9 +186,400 @@ def control_plane_transport(
     """Framed-TCP control-plane endpoint for the BRB trust plane between
     hosts (the DCN path; simulation uses ``InMemoryHub`` instead). Thin
     convenience over ``protocol.transport.TCPTransport``: same wire codec as
-    every other control message (length-prefixed JSON, no pickle)."""
+    every other control message (length-prefixed JSON, no pickle).
+    ``MultiHostTrustPlane`` builds on this."""
     from p2pdl_tpu.protocol.transport import TCPTransport
 
     t = TCPTransport(my_peer_id, bind_host, bind_port, handler)
     t.start()
     return t
+
+
+def shard_peer_state(state, cfg: Config, topo: HostTopology, mesh):
+    """Multi-host placement of a ``PeerState``: peer-stacked leaves become
+    globally-sharded arrays from each host's local slice
+    (``jax.make_array_from_process_local_data``); replicated leaves are
+    materialized identically on every host. The single-host analogue is
+    ``parallel.peer_state.shard_state``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from p2pdl_tpu.parallel.mesh import peer_sharding
+    from p2pdl_tpu.parallel.peer_state import PeerState, params_layout
+
+    ps = peer_sharding(mesh)
+    rs = NamedSharding(mesh, P())
+    sl = host_peer_slice(cfg, topo, mesh)
+
+    def put_peer(leaf):
+        local = np.asarray(leaf)
+        if local.shape[0] == cfg.num_peers and topo.num_processes > 1:
+            local = local[sl]
+        if topo.num_processes == 1:
+            return jax.device_put(local, ps)
+        return jax.make_array_from_process_local_data(ps, local)
+
+    def put_rep(leaf):
+        arr = np.asarray(leaf)
+        if topo.num_processes == 1:
+            return jax.device_put(arr, rs)
+        return jax.make_array_from_process_local_data(rs, arr)
+
+    layout = params_layout(cfg)
+    return PeerState(
+        params=jax.tree.map(put_peer if layout == "peer" else put_rep, state.params),
+        opt_state=jax.tree.map(
+            lambda l: put_peer(l) if getattr(l, "ndim", 0) >= 1 else put_rep(l),
+            state.opt_state,
+        ),
+        rng=put_peer(state.rng),
+        round_idx=put_rep(state.round_idx),
+    )
+
+
+def addressable_row(arr, row: int) -> np.ndarray:
+    """Extract global row ``row`` of a peer-sharded array from this host's
+    addressable shards (device->host of one row, no cross-host transfer)."""
+    for sh in arr.addressable_shards:
+        idx = sh.index[0]
+        start = idx.start or 0
+        stop = idx.stop if idx.stop is not None else arr.shape[0]
+        if start <= row < stop:
+            return np.asarray(sh.data)[row - start]
+    raise ValueError(f"row {row} is not addressable from process {jax.process_index()}")
+
+
+class MultiHostTrustPlane:
+    """The BRB trust plane across hosts, riding framed TCP (the DCN path).
+
+    Each host runs Bracha ``Broadcaster`` instances for its OWN peers only
+    and fans protocol messages out over ``TCPTransport`` (reference parity:
+    the echo/ready mesh of ``utils/broadcast.py:8-141``, minus its
+    one-process assumption). Per round:
+
+    1. hosts owning this round's trainers BRB-broadcast the trainers'
+       update digests (``crypto.digest_update`` of the addressable delta
+       rows — content commitments only cross hosts as 32-byte digests, the
+       updates themselves never leave the data plane);
+    2. every host reports its local peers' delivery verdicts (plus digest
+       attestations for the trainers it owns) to the coordinator;
+    3. the coordinator computes the global verdict — failed peers
+       (receiver faults), verified trainers (delivered everywhere live with
+       the attested digest) — and broadcasts the decision, which every host
+       applies identically to gate the aggregate.
+
+    Content verification is attestation-based across hosts: a trainer's
+    digest is checked against the on-device delta by its OWNING host (in
+    the SPMD data plane individual updates are never shipped peer-to-peer,
+    so only the owner can digest them; a host Byzantine toward its own
+    peers is outside this trust model — it controls those peers outright).
+
+    Message handling is single-threaded: transport threads only enqueue;
+    ``_pump`` drains on the caller's thread, so broadcaster state needs no
+    locks (SURVEY §5 race-safety stance).
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        topo: HostTopology,
+        mesh,
+        host_addrs: list[tuple[str, int]],
+        bind_host: str = "127.0.0.1",
+    ) -> None:
+        from p2pdl_tpu.protocol.brb import BRBConfig, Broadcaster
+        from p2pdl_tpu.protocol.crypto import (
+            KeyServer,
+            generate_key_pair,
+            public_key_from_pem,
+            public_key_pem,
+        )
+
+        self.cfg = cfg
+        self.topo = topo
+        sl = host_peer_slice(cfg, topo, mesh)
+        self.local_peers = list(range(sl.start, sl.stop))
+        self.key_server = KeyServer()
+        self._from_pem = public_key_from_pem
+        self._queue: queue.Queue = queue.Queue()
+        self.host_addrs = host_addrs
+        self.transport = control_plane_transport(
+            topo.process_id,
+            bind_host,
+            host_addrs[topo.process_id][1],
+            lambda src, data: self._queue.put(data),
+        )
+        for h, (hh, pp) in enumerate(host_addrs):
+            self.transport.add_peer(h, hh, pp)
+
+        brb_cfg = BRBConfig(cfg.num_peers, cfg.byzantine_f)
+        self._pems: dict[int, str] = {}
+        self.broadcasters = {}
+        for pid in self.local_peers:
+            priv, pub = generate_key_pair()
+            self.key_server.register_key(pid, pub)
+            self._pems[pid] = public_key_pem(pub).decode()
+            self.broadcasters[pid] = Broadcaster(brb_cfg, pid, self.key_server, priv)
+        self._reports: dict[int, dict] = {}
+        self._decision: Optional[dict] = None
+        self._acks: set[int] = set()
+
+    # -- wire helpers ------------------------------------------------------
+    def _send_host(self, h: int, obj: dict) -> None:
+        data = json.dumps(obj).encode()
+        if h == self.topo.process_id:
+            self._queue.put(data)
+        else:
+            self.transport.send(h, data)
+
+    def _broadcast_hosts(self, obj: dict) -> None:
+        for h in range(self.topo.num_processes):
+            self._send_host(h, obj)
+
+    def _fan_out_brb(self, msg) -> None:
+        from p2pdl_tpu.protocol.transport import brb_to_wire
+
+        wire = base64.b64encode(brb_to_wire(msg)).decode()
+        self._broadcast_hosts({"t": "brb", "host": self.topo.process_id, "w": wire})
+
+    def _handle(self, data: bytes) -> None:
+        from p2pdl_tpu.protocol.transport import brb_from_wire
+
+        try:
+            obj = json.loads(data)
+        except ValueError:
+            return
+        kind = obj.get("t")
+        # Any protocol message past the key phase implies its host passed
+        # the ack barrier — a lost final ack must not starve a slow host.
+        if kind in ("brb", "report", "decision") and "host" in obj:
+            self._acks.add(int(obj["host"]))
+        if kind == "keys":
+            for pid_s, pem in obj.get("keys", {}).items():
+                self.key_server.register_key(int(pid_s), self._from_pem(pem.encode()))
+        elif kind == "brb":
+            msg = brb_from_wire(base64.b64decode(obj["w"]))
+            if msg is None:
+                return
+            for bc in self.broadcasters.values():
+                for out in bc.handle(msg):
+                    self._fan_out_brb(out)
+        elif kind == "keys_ack":
+            self._acks.add(int(obj["host"]))
+        elif kind == "report":
+            self._reports[int(obj["host"])] = obj
+        elif kind == "decision":
+            self._decision = obj
+
+    def _pump(self, deadline: float, done) -> bool:
+        while True:
+            if done():
+                return True
+            if time.monotonic() >= deadline:
+                return done()
+            try:
+                data = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            self._handle(data)
+
+    # -- protocol rounds ---------------------------------------------------
+    def exchange_keys(self, timeout_s: float = 30.0) -> None:
+        """Full pubkey directory on every host before any BRB signature
+        verification (the reference shares one in-process KeyServer,
+        ``main.py:18`` — here keys cross hosts as PEM, never private).
+
+        The announcement is re-sent every second until the directory fills:
+        hosts start listeners at their own pace and ``TCPTransport.send`` is
+        fire-and-forget, so a single early send can land before the remote
+        listener is bound and vanish (re-registration of an identical key is
+        a no-op, so resends are safe)."""
+        msg = {
+            "t": "keys",
+            "host": self.topo.process_id,
+            "keys": {str(p): pem for p, pem in self._pems.items()},
+        }
+        deadline = time.monotonic() + timeout_s
+        done = lambda: len(self.key_server) == self.cfg.num_peers  # noqa: E731
+        full = False
+        while time.monotonic() < deadline:
+            self._broadcast_hosts(msg)
+            if self._pump(min(time.monotonic() + 1.0, deadline), done):
+                full = True
+                break
+        if not full:
+            raise TimeoutError(
+                f"key exchange incomplete: {len(self.key_server)}/{self.cfg.num_peers}"
+            )
+        # Ack barrier: a host's own full directory does not imply its PEERS
+        # have this host's keys yet — BRB messages signed by unknown keys
+        # would be silently dropped. Proceed only once every host acked.
+        # Keep announcing keys here too: a slow-starting host may have missed
+        # every pre-barrier announcement (its listener binds after jit
+        # compile), and without re-announcement the fast host would ack
+        # forever while the slow one starves at a partial directory.
+        acked = lambda: len(self._acks) == self.topo.num_processes  # noqa: E731
+        while time.monotonic() < deadline:
+            self._broadcast_hosts(msg)
+            self._broadcast_hosts({"t": "keys_ack", "host": self.topo.process_id})
+            if self._pump(min(time.monotonic() + 1.0, deadline), acked):
+                return
+        raise TimeoutError(
+            f"key-exchange ack barrier incomplete: {len(self._acks)}/{self.topo.num_processes}"
+        )
+
+    def _payload(self, round_idx: int, tid: int, digest: bytes) -> bytes:
+        return json.dumps(
+            {"round": round_idx, "trainer": tid, "digest": digest.hex()}
+        ).encode()
+
+    def run_round(
+        self,
+        round_idx: int,
+        trainer_ids: list[int],
+        local_digests: dict[int, bytes],
+        equivocate: tuple[int, ...] = (),
+    ) -> tuple[list[int], list[int]]:
+        """One trust round; returns ``(failed_peers, verified_trainers)`` —
+        identical on every host (coordinator decision). ``local_digests``
+        covers the trainers this host owns. ``equivocate`` is fault
+        injection: those owned trainers send conflicting digests to the two
+        halves of the host set."""
+        my_trainers = [t for t in trainer_ids if t in self.broadcasters]
+        for tid in my_trainers:
+            payload = self._payload(round_idx, tid, local_digests[tid])
+            if tid in equivocate:
+                forged = self._payload(round_idx, tid, b"\xff" * 32)
+                a, b = self.broadcasters[tid].broadcast_equivocating(
+                    round_idx, payload, forged
+                )
+                half = self.topo.num_processes // 2 or 1
+                from p2pdl_tpu.protocol.transport import brb_to_wire
+
+                for h in range(self.topo.num_processes):
+                    wire = base64.b64encode(brb_to_wire(a if h < half else b)).decode()
+                    self._send_host(h, {"t": "brb", "w": wire})
+            else:
+                for msg in self.broadcasters[tid].broadcast(round_idx, payload):
+                    self._fan_out_brb(msg)
+
+        # Phase deadlines are independent: a sender whose broadcast can never
+        # deliver (dead / equivocating) exhausts the delivery window, and the
+        # report/decision phase still needs its own full window after that.
+        self._pump(
+            time.monotonic() + self.cfg.round_timeout_s,
+            lambda: all(
+                self.broadcasters[p].delivered(t, round_idx) is not None
+                for p in self.local_peers
+                for t in trainer_ids
+            ),
+        )
+
+        # Local verdict report: per trainer, which of my peers delivered,
+        # and one delivered payload sample (BRB guarantees agreement).
+        delivered: dict[str, list[int]] = {}
+        payloads: dict[str, Optional[str]] = {}
+        for t in trainer_ids:
+            got = [
+                p
+                for p in self.local_peers
+                if self.broadcasters[p].delivered(t, round_idx) is not None
+            ]
+            delivered[str(t)] = got
+            sample = (
+                self.broadcasters[got[0]].delivered(t, round_idx) if got else None
+            )
+            payloads[str(t)] = (
+                base64.b64encode(sample).decode() if sample is not None else None
+            )
+        report = {
+            "t": "report",
+            "host": self.topo.process_id,
+            "round": round_idx,
+            "delivered": delivered,
+            "payloads": payloads,
+            "attest": {str(t): local_digests[t].hex() for t in my_trainers},
+        }
+        decision_deadline = time.monotonic() + self.cfg.round_timeout_s
+        if self.topo.is_coordinator:
+            self._send_host(0, report)
+            self._pump(
+                decision_deadline,
+                lambda: len(
+                    [r for r in self._reports.values() if r.get("round") == round_idx]
+                )
+                == self.topo.num_processes,
+            )
+            decision = self._decide(round_idx, trainer_ids)
+            self._broadcast_hosts(
+                {"t": "decision", "host": self.topo.process_id,
+                 "round": round_idx, **decision}
+            )
+            # Apply the freshly-computed decision directly: report collection
+            # may have exhausted decision_deadline, and the coordinator must
+            # not time out waiting for its own loop-back frame while the
+            # other hosts apply the decision and proceed.
+            self._decision = {"round": round_idx, **decision}
+
+        def have_decision() -> bool:
+            return (
+                self._decision is not None
+                and self._decision.get("round") == round_idx
+            )
+
+        # Non-coordinators re-send their report until the decision lands —
+        # a single lost report frame must not zero out a host's verdicts.
+        while time.monotonic() < decision_deadline and not have_decision():
+            if not self.topo.is_coordinator:
+                self._send_host(0, report)
+            self._pump(min(time.monotonic() + 1.0, decision_deadline), have_decision)
+        if not have_decision():
+            raise TimeoutError("no trust-plane decision before timeout")
+        decision = self._decision
+        self._decision = None
+        self._reports = {}
+        for bc in self.broadcasters.values():
+            bc.prune(round_idx)
+        return list(decision["failed"]), list(decision["verified"])
+
+    def _decide(self, round_idx: int, trainer_ids: list[int]) -> dict:
+        """Coordinator: combine host reports into the global verdict (same
+        sender-vs-receiver failure logic as the single-process trust plane,
+        ``runtime.driver._TrustPlane.run_round``)."""
+        delivered_at: dict[int, set[int]] = {t: set() for t in trainer_ids}
+        attested: dict[int, str] = {}
+        payload_by_trainer: dict[int, set[str]] = {t: set() for t in trainer_ids}
+        for rep in self._reports.values():
+            if rep.get("round") != round_idx:
+                continue
+            for t_s, peers in rep.get("delivered", {}).items():
+                delivered_at[int(t_s)].update(peers)
+            for t_s, digest_hex in rep.get("attest", {}).items():
+                attested[int(t_s)] = digest_hex
+            for t_s, b64_payload in rep.get("payloads", {}).items():
+                if b64_payload is not None:
+                    payload_by_trainer[int(t_s)].add(b64_payload)
+        sender_failed = {t for t in trainer_ids if not delivered_at[t]}
+        failed = [
+            p
+            for p in range(self.cfg.num_peers)
+            if any(
+                p not in delivered_at[t]
+                for t in trainer_ids
+                if t not in sender_failed
+            )
+        ]
+        live = [p for p in range(self.cfg.num_peers) if p not in failed]
+        verified = []
+        for t in trainer_ids:
+            if t in sender_failed or t not in attested:
+                continue
+            if not live or not all(p in delivered_at[t] for p in live):
+                continue
+            wires = payload_by_trainer[t]
+            expected = self._payload(round_idx, t, bytes.fromhex(attested[t]))
+            if len(wires) == 1 and base64.b64decode(next(iter(wires))) == expected:
+                verified.append(t)
+        return {"failed": failed, "verified": verified}
+
+    def stop(self) -> None:
+        self.transport.stop()

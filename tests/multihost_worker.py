@@ -1,0 +1,121 @@
+"""Worker process for the 2-process multi-host tests.
+
+Each instance is one "host" of a ``jax.distributed`` job on the CPU backend
+(2 local virtual devices per process, gloo cross-process collectives): it
+joins the job, builds the global peer mesh, runs ONE full BRB-gated
+federated round — local SGD on its addressable data shard, digest BRB over
+``TCPTransport`` between the processes, gated aggregate via cross-process
+``psum`` — and prints one JSON verdict line for the test to compare across
+hosts. Run by ``tests/test_multihost_2proc.py``, never by pytest directly.
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    pid, nproc, coord_port, base_port = (int(a) for a in sys.argv[1:5])
+    equivocate = "--equivocate" in sys.argv
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2pdl_tpu.config import Config
+    from p2pdl_tpu.data import make_federated_data
+    from p2pdl_tpu.parallel import build_trust_round_fns, init_peer_state
+    from p2pdl_tpu.protocol.crypto import digest_update
+    from p2pdl_tpu.runtime import multihost
+
+    topo = multihost.initialize(f"127.0.0.1:{coord_port}", pid, nproc)
+    assert topo.num_processes == nproc, topo
+    mesh = multihost.global_mesh()
+
+    cfg = Config(
+        num_peers=8,
+        trainers_per_round=4,
+        local_epochs=2,
+        samples_per_peer=16,
+        batch_size=8,
+        lr=0.05,
+        server_lr=1.0,
+        compute_dtype="float32",
+        brb_enabled=True,
+        byzantine_f=2,
+        # Also bounds the delivery pump when a broadcast can never deliver
+        # (the equivocation variant) — keep it short for test wall-clock.
+        round_timeout_s=8.0,
+    )
+    # Deterministic generation from the seed on every host; each host feeds
+    # only its addressable shard (the host_local_batch contract).
+    data = make_federated_data(cfg, eval_samples=16)
+    state = multihost.shard_peer_state(init_peer_state(cfg), cfg, topo, mesh)
+    x = multihost.host_local_batch(np.asarray(data.x), cfg, topo, mesh)
+    y = multihost.host_local_batch(np.asarray(data.y), cfg, topo, mesh)
+
+    train_fn, agg_fn = build_trust_round_fns(cfg, mesh)
+    trainers = np.asarray([0, 2, 5, 7])
+    mask_key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), 0)
+    byz = jnp.zeros(cfg.num_peers)
+
+    delta, new_opt, losses = train_fn(state, x, y, byz, mask_key)
+    jax.block_until_ready(losses)
+
+    # Digest the trainers THIS host owns (only their delta rows are
+    # addressable here — updates never cross hosts, digests do).
+    sl = multihost.host_peer_slice(cfg, topo, mesh)
+    my_trainers = [int(t) for t in trainers if sl.start <= t < sl.stop]
+    digests = {
+        t: digest_update(
+            jax.tree.map(lambda d, t=t: multihost.addressable_row(d, t), delta)
+        )
+        for t in my_trainers
+    }
+
+    host_addrs = [("127.0.0.1", base_port + h) for h in range(nproc)]
+    tp = multihost.MultiHostTrustPlane(cfg, topo, mesh, host_addrs)
+    try:
+        # Generous window: the hosts reach the exchange at different times
+        # (each binds its listener only after its own jit compile).
+        tp.exchange_keys(timeout_s=120.0)
+        failed, verified = tp.run_round(
+            0,
+            [int(t) for t in trainers],
+            digests,
+            equivocate=(0,) if equivocate else (),
+        )
+    finally:
+        tp.stop()
+
+    gated = np.where(np.isin(trainers, verified), trainers, -1)
+    state = agg_fn(state, delta, new_opt, jnp.asarray(gated, jnp.int32), mask_key)
+
+    # Params are replicated: every host must hold identical bytes.
+    checksum = float(
+        sum(np.abs(np.asarray(leaf)).sum() for leaf in jax.tree.leaves(state.params))
+    )
+    local_loss = float(
+        np.mean([np.asarray(s.data).mean() for s in losses.addressable_shards])
+    )
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "devices": jax.device_count(),
+                "local_devices": jax.local_device_count(),
+                "failed": sorted(failed),
+                "verified": sorted(verified),
+                "checksum": round(checksum, 4),
+                "local_loss_finite": bool(np.isfinite(local_loss)),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()
