@@ -59,6 +59,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="attention implementation for transformer models "
         "(flash = fused Pallas TPU kernels)",
     )
+    p.add_argument(
+        "--seq-shards",
+        type=int,
+        default=1,
+        help="sequence/context parallelism: shard each peer's token "
+        "sequence over a mesh axis of this size (ring attention); 1=off",
+    )
+    p.add_argument(
+        "--vit-pool",
+        choices=["cls", "mean"],
+        default="cls",
+        help="ViT head pooling (mean required under --seq-shards > 1)",
+    )
     p.add_argument("--attack", default="none", help="Byzantine attack for injected peers")
     p.add_argument("--byz-ids", default="", help="comma-separated adversarial peer ids")
     p.add_argument("--log-path", default=None, help="JSONL metrics output")
@@ -113,6 +126,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         param_dtype=args.param_dtype,
         remat=args.remat,
         attn_impl=args.attn_impl,
+        seq_shards=args.seq_shards,
+        vit_pool=args.vit_pool,
     )
 
 

@@ -91,6 +91,14 @@ class Config:
     # Attention implementation for transformer models ("dense" | "flash";
     # flash = fused Pallas TPU kernels, ops/pallas_attention.py).
     attn_impl: str = "dense"
+    # Sequence/context parallelism: shard each peer's token sequence over a
+    # second mesh axis of this size; attention runs as exact ring attention
+    # (ops/ring_attention.py) over ICI. 1 = off. Requires an attention model
+    # (vit_tiny) with vit_pool="mean".
+    seq_shards: int = 1
+    # ViT head: "cls" token (default) or "mean" pooling (required — and
+    # psum-reduced — under sequence parallelism).
+    vit_pool: str = "cls"
 
     def __post_init__(self) -> None:
         if self.num_peers < 2:
@@ -119,6 +127,29 @@ class Config:
                 f"attn_impl='flash' requires an attention model (vit_tiny); "
                 f"model={self.model!r} has no attention"
             )
+        if self.vit_pool not in ("cls", "mean"):
+            raise ValueError(f"unknown vit_pool {self.vit_pool!r}; one of ('cls', 'mean')")
+        if self.seq_shards < 1:
+            raise ValueError(f"seq_shards must be >= 1, got {self.seq_shards}")
+        if self.seq_shards > 1:
+            if self.model != "vit_tiny":
+                raise ValueError(
+                    f"seq_shards > 1 requires an attention model (vit_tiny); "
+                    f"model={self.model!r} has no sequence axis to shard"
+                )
+            if self.vit_pool != "mean":
+                raise ValueError(
+                    "seq_shards > 1 requires vit_pool='mean' (a CLS token "
+                    "lives on one shard and breaks the uniform block layout)"
+                )
+            if self.aggregator == "gossip":
+                raise ValueError("seq_shards > 1 is not supported with gossip")
+            if self.brb_enabled:
+                raise ValueError(
+                    "seq_shards > 1 with the BRB trust plane is not yet "
+                    "supported (the split-round digest path assumes a 1-D "
+                    "peer mesh)"
+                )
         if self.robust_impl not in ("blockwise", "gathered"):
             raise ValueError(
                 f"unknown robust_impl {self.robust_impl!r}; one of ('blockwise', 'gathered')"

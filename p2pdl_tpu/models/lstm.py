@@ -10,7 +10,24 @@ compiled loop with static shapes.
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+from jax import lax
+
+
+def _match_vma(carry, ref: jnp.ndarray):
+    """Give the fresh zero carry the same varying-manual-axes type as the
+    activations it will be scanned with. Inside ``shard_map`` the scan body
+    produces peer-varying carries, and a vma-invariant initial carry would
+    fail the scan's carry type check; outside ``shard_map`` this is a no-op.
+    """
+    try:
+        vma = tuple(jax.typeof(ref).vma)
+    except Exception:
+        return carry
+    if not vma:
+        return carry
+    return jax.tree.map(lambda c: lax.pcast(c, vma, to="varying"), carry)
 
 
 class CharLSTM(nn.Module):
@@ -23,5 +40,9 @@ class CharLSTM(nn.Module):
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         h = nn.Embed(self.vocab_size, self.embed_dim)(x)
         for _ in range(self.num_layers):
-            h = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(h)
+            cell = nn.OptimizedLSTMCell(self.hidden)
+            carry = _match_vma(
+                cell.initialize_carry(jax.random.PRNGKey(0), h[:, 0].shape), h
+            )
+            h = nn.RNN(cell)(h, initial_carry=carry)
         return nn.Dense(self.vocab_size)(h)

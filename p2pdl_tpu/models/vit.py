@@ -3,14 +3,26 @@
 Beyond the reference's model zoo; required by the BASELINE.json ViT-Tiny
 config. Standard ViT-Tiny geometry (dim 192, depth 12, 3 heads) with a 4x4
 patch stem sized for 32x32 inputs. Attention is factored through
-``p2pdl_tpu.ops.attention`` so the same blocks can run single-device or
+``p2pdl_tpu.ops.attention`` so the same blocks run single-device or
 sequence-parallel (ring attention) over a mesh axis.
+
+Sequence parallelism (``seq_axis`` set, called inside ``shard_map`` with the
+input's HEIGHT dimension sharded on that axis): the 4x4 patch stem is
+stride-aligned so each shard patchifies its own row block locally (no halo),
+patch order is row-major so shard blocks concatenate to the global token
+sequence in mesh order, position embeddings are the full (replicated) table
+sliced per shard, attention runs as exact ring attention, and the head
+mean-pools with a ``psum`` over the axis. Requires ``pool='mean'`` — a CLS
+token lives on one shard and would break the uniform block layout. Param
+shapes are identical to the dense ``seq_axis=None`` twin, so one init/eval
+model serves both.
 """
 
 from __future__ import annotations
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 
 from p2pdl_tpu.ops.attention import MultiHeadAttention
 
@@ -20,11 +32,14 @@ class TransformerBlock(nn.Module):
     heads: int
     mlp_ratio: int = 4
     attn_impl: str = "dense"
+    seq_axis: str | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         y = nn.LayerNorm()(x)
-        x = x + MultiHeadAttention(self.dim, self.heads, impl=self.attn_impl)(y)
+        x = x + MultiHeadAttention(
+            self.dim, self.heads, impl=self.attn_impl, seq_axis=self.seq_axis
+        )(y)
         y = nn.LayerNorm()(x)
         y = nn.Dense(self.dim * self.mlp_ratio)(y)
         y = nn.gelu(y)
@@ -39,18 +54,58 @@ class ViTTiny(nn.Module):
     heads: int = 3
     num_classes: int = 10
     attn_impl: str = "dense"  # "flash" fuses attention via Pallas on TPU
+    pool: str = "cls"  # "cls" | "mean"
+    seq_axis: str | None = None  # mesh axis the token sequence is sharded on
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.seq_axis is not None and self.pool != "mean":
+            raise ValueError("sequence-parallel ViT requires pool='mean'")
+        if x.shape[1] % self.patch != 0:
+            # Without this, nn.Conv's SAME padding would silently pad each
+            # (shard-local) height block, breaking the exact equivalence to
+            # the dense twin.
+            raise ValueError(
+                f"input height {x.shape[1]} (the per-shard block under "
+                f"sequence parallelism) must be divisible by patch={self.patch}"
+            )
         b = x.shape[0]
         x = nn.Conv(self.dim, (self.patch, self.patch), strides=(self.patch, self.patch))(x)
-        x = x.reshape(b, -1, self.dim)  # [B, tokens, dim]
-        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.dim))
-        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.dim)), x], axis=1)
-        x = x + self.param(
-            "pos_embed", nn.initializers.normal(0.02), (1, x.shape[1], self.dim)
+        x = x.reshape(b, -1, self.dim)  # [B, local tokens, dim]
+        t_local = x.shape[1]
+        if self.seq_axis is not None:
+            n_shards = lax.axis_size(self.seq_axis)
+            t_global = t_local * n_shards
+        else:
+            t_global = t_local
+
+        if self.pool == "cls":
+            cls = self.param("cls", nn.initializers.zeros, (1, 1, self.dim))
+            x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.dim)), x], axis=1)
+            t_global += 1
+            t_local += 1
+        # Full position table regardless of sharding (identical param shapes
+        # for the dense and sequence-parallel twins); each shard reads its
+        # row-major block.
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, t_global, self.dim)
         )
+        if self.seq_axis is not None:
+            start = lax.axis_index(self.seq_axis) * t_local
+            pos = lax.dynamic_slice(pos, (0, start, 0), (1, t_local, self.dim))
+        x = x + pos
+
         for _ in range(self.depth):
-            x = TransformerBlock(self.dim, self.heads, attn_impl=self.attn_impl)(x)
+            x = TransformerBlock(
+                self.dim, self.heads, attn_impl=self.attn_impl, seq_axis=self.seq_axis
+            )(x)
         x = nn.LayerNorm()(x)
-        return nn.Dense(self.num_classes)(x[:, 0])
+        if self.pool == "cls":
+            pooled = x[:, 0]
+        else:
+            pooled = jnp.mean(x, axis=1)
+            if self.seq_axis is not None:
+                # Tokens are split over the axis: the global mean is the
+                # mean of per-shard means (equal block sizes).
+                pooled = lax.pmean(pooled, self.seq_axis)
+        return nn.Dense(self.num_classes)(pooled)

@@ -59,18 +59,14 @@ class MultiHeadAttention(nn.Module):
         q, k, v = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))  # [B, H, T, D]
         if self.impl not in ("dense", "flash"):
             raise ValueError(f"unknown attention impl {self.impl!r}; one of ('dense', 'flash')")
-        if self.seq_axis is not None and self.impl == "flash":
-            # The ring path computes its per-block attention internally; a
-            # flash request would be silently ignored — reject it instead
-            # until the ring blocks call the fused kernel.
-            raise ValueError(
-                "impl='flash' is not yet supported together with seq_axis "
-                "(ring attention); use impl='dense' with seq_axis"
-            )
         if self.seq_axis is not None:
             from p2pdl_tpu.ops.ring_attention import ring_attention
 
-            out = ring_attention(q, k, v, self.seq_axis, causal=self.causal)
+            # impl selects the per-block compute inside the ring: "flash"
+            # merges fused-kernel blocks exactly via their logsumexp.
+            out = ring_attention(
+                q, k, v, self.seq_axis, causal=self.causal, impl=self.impl
+            )
         elif self.impl == "flash":
             from p2pdl_tpu.ops.pallas_attention import flash_attention
 

@@ -307,6 +307,10 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    return _flash_bwd_impl(causal, block_q, block_k, interpret, res, g, None)
+
+
+def _flash_bwd_impl(causal, block_q, block_k, interpret, res, g, g_lse):
     q, k, v, out, lse = res
     bh, tq, d = q.shape
     tk = k.shape[1]
@@ -315,8 +319,12 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
 
-    # delta_i = rowsum(do * o): the softmax-jacobian correction term.
+    # delta_i = rowsum(do * o): the softmax-jacobian correction term. An lse
+    # cotangent folds into the same term: d lse/d s_j = p_j, so
+    # ds = p*(dp - delta) + g_lse*p = p*(dp - (delta - g_lse)).
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
 
     qp, dop = _pad_t(q, block_q), _pad_t(g, block_q)
     kp, vp = _pad_t(k, block_k), _pad_t(v, block_k)
@@ -380,6 +388,68 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, block_q, block_k, interpret, res, g):
+    g_out, g_lse = g
+    return _flash_bwd_impl(causal, block_q, block_k, interpret, res, g_out, g_lse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def _dense_with_lse(q, k, v, causal):
+    """Dense (out, lse) with ``sdpa``'s exact masking semantics — the
+    off-TPU route for ``flash_attention_with_lse``; also the oracle in
+    tests. ``q, k, v``: [B, H, T, D]."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    s = s * scale
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.where(jnp.isfinite(s), jnp.exp(s - safe_m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    lse = jnp.where(jnp.isfinite(m), m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p / jnp.maximum(l, 1e-30)[..., None], v.astype(jnp.float32))
+    return out.astype(q.dtype), lse
+
+
+def flash_attention_with_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret=None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused attention returning ``(out [B,H,T,D], lse [B,H,T])`` — the
+    per-row logsumexp lets callers merge partial attention over key blocks
+    exactly (flash-inside-ring: ``ops.ring_attention`` with impl='flash').
+    Differentiable in both outputs. Same auto-routing as
+    :func:`flash_attention`."""
+    if interpret is None:
+        if not _on_tpu():
+            return _dense_with_lse(q, k, v, causal)
+        interpret = False
+    b, h, t, d = q.shape
+    flat = lambda x: x.reshape(b * h, x.shape[2], x.shape[-1])
+    out, lse = _flash_lse(flat(q), flat(k), flat(v), causal, block_q, block_k, interpret)
+    return out.reshape(b, h, t, v.shape[-1]), lse.reshape(b, h, t)
 
 
 def flash_attention(

@@ -28,6 +28,7 @@ def ring_attention(
     v: jnp.ndarray,
     axis_name: str,
     causal: bool = False,
+    impl: str = "dense",
 ) -> jnp.ndarray:
     """Attention over a sequence sharded on ``axis_name``.
 
@@ -35,17 +36,30 @@ def ring_attention(
     the global sequence is the concatenation of blocks in mesh order.
     Returns the local ``[B, H, T_local, D]`` output block, bitwise-equivalent
     (up to float assoc.) to slicing dense attention over the full sequence.
+
+    ``impl``: per-block compute. ``"dense"`` is the inline online-softmax
+    recurrence below; ``"flash"`` computes each block with the fused Pallas
+    kernel (``pallas_attention.flash_attention_with_lse``) and merges blocks
+    exactly via their logsumexp — the long-context path where even one
+    ``[T_local, T_local]`` score matrix must not hit HBM.
     """
+    if impl == "flash":
+        return _ring_flash(q, k, v, axis_name, causal)
+    if impl != "dense":
+        raise ValueError(f"unknown ring attention impl {impl!r}")
     n_dev = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     t_local = q.shape[2]
     scale = q.shape[-1] ** -0.5
     q32 = q.astype(jnp.float32) * scale
 
-    # Running flash-attention accumulators, tagged as varying over the mesh
-    # axis so the scan carry types match the block-dependent updates.
+    # Running flash-attention accumulators, tagged as varying over the ring
+    # axis AND every axis the operands already vary over (inside the peer
+    # round, q is peer-varying too) so the scan carry types match the
+    # block-dependent updates.
     def _vary(x):
-        return lax.pcast(x, axis_name, to="varying")
+        axes = frozenset(jax.typeof(q).vma) | {axis_name}
+        return lax.pcast(x, tuple(axes), to="varying")
 
     o = _vary(jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32))
     m = _vary(jnp.full(q.shape[:3], -jnp.inf, jnp.float32))
@@ -85,3 +99,74 @@ def ring_attention(
     )
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)
+
+
+def _ring_flash(q, k, v, axis_name: str, causal: bool) -> jnp.ndarray:
+    """Ring attention with fused per-block kernels.
+
+    Each rotation computes one ``(out_s, lse_s)`` block pair with the flash
+    kernel and folds it into running ``(o, lse)`` accumulators:
+    ``o' = o*exp(lse - lse') + out_s*exp(lse_s - lse')`` with
+    ``lse' = logaddexp(lse, lse_s)`` — exact blockwise softmax composition.
+    Under causality the block relation is static per (my_idx, src) pair only
+    at runtime, so the three cases (diagonal = causal kernel, past = full
+    kernel, future = skip) dispatch through ``lax.switch``.
+    """
+    from p2pdl_tpu.ops.pallas_attention import flash_attention_with_lse
+
+    n_dev = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+
+    def _vary(x):
+        axes = frozenset(jax.typeof(q).vma) | {axis_name}
+        return lax.pcast(x, tuple(axes), to="varying")
+
+    o0 = _vary(jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32))
+    lse0 = _vary(jnp.full(q.shape[:3], -jnp.inf, jnp.float32))
+
+    def block(k_cur, v_cur, s):
+        if not causal:
+            return flash_attention_with_lse(q, k_cur, v_cur, causal=False)
+        src = (my_idx - s) % n_dev
+
+        def diag(args):
+            return flash_attention_with_lse(*args, causal=True)
+
+        def past(args):
+            return flash_attention_with_lse(*args, causal=False)
+
+        def future(args):
+            qq, kk, vv = args
+            # Match the kernel branches' vma typing exactly (lax.switch
+            # requires equal output types): the zeros must claim the same
+            # varying axes as a real block result would.
+            vma = tuple(
+                frozenset(jax.typeof(qq).vma)
+                | frozenset(jax.typeof(kk).vma)
+                | frozenset(jax.typeof(vv).vma)
+            )
+            out = jnp.zeros(qq.shape[:3] + (vv.shape[-1],), qq.dtype)
+            lse = jnp.full(qq.shape[:3], -jnp.inf, jnp.float32)
+            if vma:
+                out = lax.pcast(out, vma, to="varying")
+                lse = lax.pcast(lse, vma, to="varying")
+            return out, lse
+
+        branch = jnp.where(src == my_idx, 0, jnp.where(src < my_idx, 1, 2))
+        return lax.switch(branch, (diag, past, future), (q, k_cur, v_cur))
+
+    def step(carry, s):
+        o, lse, k_cur, v_cur = carry
+        out_s, lse_s = block(k_cur, v_cur, s)
+        lse_new = jnp.logaddexp(lse, lse_s)
+        safe = jnp.where(jnp.isfinite(lse_new), lse_new, 0.0)
+        w_old = jnp.where(jnp.isfinite(lse), jnp.exp(lse - safe), 0.0)
+        w_new = jnp.where(jnp.isfinite(lse_s), jnp.exp(lse_s - safe), 0.0)
+        o = o * w_old[..., None] + out_s.astype(jnp.float32) * w_new[..., None]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o, lse_new, k_nxt, v_nxt), None
+
+    (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(n_dev))
+    return o.astype(q.dtype)

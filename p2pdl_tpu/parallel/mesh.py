@@ -14,17 +14,30 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 PEER_AXIS = "peers"
+# Second mesh axis for sequence/context parallelism: with ``seq_shards > 1``
+# the device grid is (peers x seq); each peer's token sequence is sharded
+# over the seq axis and attention runs as ring attention over ICI.
+SEQ_AXIS = "seq"
 
 
-def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
-    """A 1-D mesh over ``n_devices`` (default: all) named ``"peers"``."""
+def make_mesh(n_devices: int | None = None, devices=None, seq_shards: int = 1) -> Mesh:
+    """A mesh named ``("peers",)`` — or ``("peers", "seq")`` when
+    ``seq_shards > 1``, splitting the ``n_devices`` grid so that
+    ``n_peer_devices = n_devices // seq_shards``."""
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
         if n_devices > len(devices):
             raise ValueError(f"requested {n_devices} devices, have {len(devices)}")
         devices = devices[:n_devices]
-    return Mesh(np.asarray(devices), (PEER_AXIS,))
+    devices = np.asarray(devices)
+    if seq_shards <= 1:
+        return Mesh(devices, (PEER_AXIS,))
+    if devices.size % seq_shards != 0:
+        raise ValueError(
+            f"seq_shards ({seq_shards}) must divide the device count ({devices.size})"
+        )
+    return Mesh(devices.reshape(-1, seq_shards), (PEER_AXIS, SEQ_AXIS))
 
 
 def peer_sharding(mesh: Mesh) -> NamedSharding:
@@ -32,15 +45,30 @@ def peer_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(PEER_AXIS))
 
 
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for peer-stacked INPUT arrays ``[P, S, ...]``. On a 2-D
+    (peers x seq) mesh the third dimension — image height for ViT — is
+    additionally split over the seq axis (the 4x4 patch stem is
+    stride-aligned, so each shard patchifies its row block locally)."""
+    if SEQ_AXIS in mesh.shape:
+        return NamedSharding(mesh, PartitionSpec(PEER_AXIS, None, SEQ_AXIS))
+    return peer_sharding(mesh)
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def peer_devices(mesh: Mesh) -> int:
+    """Number of devices along the peer axis (the full mesh when 1-D)."""
+    return mesh.shape[PEER_AXIS]
+
+
 def peers_per_device(num_peers: int, mesh: Mesh) -> int:
-    n_dev = mesh.devices.size
+    n_dev = peer_devices(mesh)
     if num_peers % n_dev != 0:
         raise ValueError(
-            f"num_peers ({num_peers}) must be divisible by mesh size ({n_dev}); "
-            f"round num_peers up to a multiple"
+            f"num_peers ({num_peers}) must be divisible by the peer-axis size "
+            f"({n_dev}); round num_peers up to a multiple"
         )
     return num_peers // n_dev

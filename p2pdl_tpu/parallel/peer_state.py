@@ -72,7 +72,11 @@ def make_optimizer(cfg: Config) -> optax.GradientTransformation:
     return optax.sgd(cfg.lr)
 
 
-def build_model(cfg: Config):
+def build_model(cfg: Config, seq_axis: str | None = None):
+    """Build the configured model. ``seq_axis`` names the mesh axis the
+    token sequence is sharded over (only inside ``shard_map``); the default
+    ``None`` is the dense twin — same param pytree, so init and eval share
+    one model while the compiled round runs the sequence-parallel one."""
     kwargs: dict[str, Any] = {}
     if cfg.model == "char_lstm":
         from p2pdl_tpu.data.synthetic import SHAKESPEARE_VOCAB_SIZE
@@ -80,6 +84,9 @@ def build_model(cfg: Config):
         kwargs["vocab_size"] = SHAKESPEARE_VOCAB_SIZE
     if cfg.model == "vit_tiny":
         kwargs["attn_impl"] = cfg.attn_impl
+        kwargs["pool"] = cfg.vit_pool
+        if seq_axis is not None:
+            kwargs["seq_axis"] = seq_axis
     return get_model(cfg.model, **kwargs)
 
 

@@ -51,7 +51,7 @@ from p2pdl_tpu.ops import aggregators, sharded_aggregators
 from p2pdl_tpu.ops.attacks import apply_attack
 from p2pdl_tpu.ops.gossip import ring_mix
 from p2pdl_tpu.ops.secure_agg import apply_masks
-from p2pdl_tpu.parallel.mesh import PEER_AXIS, peers_per_device
+from p2pdl_tpu.parallel.mesh import PEER_AXIS, SEQ_AXIS, peers_per_device
 from p2pdl_tpu.parallel.peer_state import (
     PeerState,
     build_model,
@@ -89,10 +89,24 @@ def make_loss_fn(model: Any, compute_dtype: jnp.dtype) -> Callable:
     return loss_fn
 
 
-def make_local_train(cfg: Config, model: Any, opt: optax.GradientTransformation) -> Callable:
+def make_local_train(
+    cfg: Config,
+    model: Any,
+    opt: optax.GradientTransformation,
+    seq_axis: str | None = None,
+) -> Callable:
     """One peer's full local-training phase (``cfg.local_epochs`` epochs of
     minibatch SGD, reshuffled per epoch) as a pure function — the jittable
-    equivalent of reference ``training/train.py:3-26``."""
+    equivalent of reference ``training/train.py:3-26``.
+
+    Under sequence parallelism (the model's ``seq_axis`` set) no explicit
+    gradient collective appears here: params stay seq-INVARIANT, so the
+    vma machinery inserts the ``psum`` over the seq axis exactly at the
+    invariant->varying boundary — each shard's token-block contribution is
+    summed once, and layers computing in the already-invariant region after
+    the pooling ``pmean`` are not double-counted. (``seq_axis`` is accepted
+    for signature symmetry; the psum is implicit.)"""
+    del seq_axis  # implicit via vma typing; see docstring
     loss_fn = make_loss_fn(model, jnp.dtype(cfg.compute_dtype))
     if cfg.remat:
         loss_fn = jax.checkpoint(loss_fn)
@@ -174,6 +188,7 @@ def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
         and attack == "none"
         and not cfg.brb_enabled
         and not cfg.remat
+        and cfg.seq_shards == 1
         and cfg.momentum == 0.0
         and cfg.local_epochs == 1
         and cfg.batches_per_epoch == 1
@@ -204,7 +219,13 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
     The input ``state`` is donated: the round overwrites it in place, so the
     caller must use the returned state (all call sites thread it through).
     """
-    model = build_model(cfg)
+    seq_axis = SEQ_AXIS if cfg.seq_shards > 1 else None
+    if seq_axis is not None and SEQ_AXIS not in mesh.shape:
+        raise ValueError(
+            f"cfg.seq_shards={cfg.seq_shards} needs a (peers x seq) mesh; "
+            f"build it with make_mesh(seq_shards=...)"
+        )
+    model = build_model(cfg, seq_axis=seq_axis)
     opt = make_optimizer(cfg)
     l_per_dev = peers_per_device(cfg.num_peers, mesh)
     emit_delta = False
@@ -216,15 +237,19 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
         body = _fast_sync_body(cfg, model, l_per_dev)
         params_spec = P()
     else:
-        body = _general_sync_body(cfg, attack, model, opt, l_per_dev)
+        body = _general_sync_body(cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis)
         params_spec = P()
 
     sp = P(PEER_AXIS)
     sr = P()
+    # Inputs [P, S, ...]: under sequence parallelism the third dimension
+    # (image height for ViT — the stride-aligned patch stem makes row blocks
+    # independent) is additionally sharded over the seq axis.
+    x_spec = P(PEER_AXIS, None, SEQ_AXIS) if seq_axis is not None else sp
     smapped = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(params_spec, sp, sp, sp, sp, sr, sr, sr, sr),
+        in_specs=(params_spec, sp, sp, x_spec, sp, sr, sr, sr, sr),
         out_specs=(params_spec, sp, sp) + ((sp,) if emit_delta else ()),
     )
 
@@ -405,22 +430,23 @@ def _fast_sync_body(cfg, model, l_per_dev):
     return body
 
 
-def _local_train_phase(cfg, attack, model, opt, l_per_dev):
+def _local_train_phase(cfg, attack, model, opt, l_per_dev, seq_axis=None):
     """Phase fragment (inside ``shard_map``): every peer's local SGD from the
     replicated global params, returning the (possibly attacked) per-peer
     deltas — the round up to the point where the reference's trainer ships
     its update (reference ``node/node.py:265-297``)."""
-    local_train = make_local_train(cfg, model, opt)
+    local_train = make_local_train(cfg, model, opt, seq_axis=seq_axis)
 
     def phase(params, opt_state, rng, x, y, byz_gate, round_idx, mask_key):
         dev = lax.axis_index(PEER_AXIS)
         local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
         round_keys = jax.vmap(lambda k: jax.random.fold_in(k, round_idx))(rng)
-        # pvary: local SGD must differentiate w.r.t. a device-VARYING view of
-        # the replicated global params — grad w.r.t. an invariant value under
+        # pvary over the PEER axis only: grad w.r.t. an invariant value under
         # shard_map gets an implicit psum inserted (transpose of the
         # replicated->varying broadcast), which would silently turn per-peer
-        # local gradients into the global sum.
+        # local gradients into the global sum. Along the SEQ axis that
+        # implicit psum is exactly the desired semantics (sum the shards'
+        # token-block gradient contributions), so params stay seq-invariant.
         pvaried = jax.lax.pcast(params, PEER_AXIS, to="varying")
         new_params, new_opt, losses = jax.vmap(
             local_train, in_axes=(None, 0, 0, 0, 0)
@@ -503,12 +529,12 @@ def _aggregate_phase(cfg, l_per_dev):
     return phase
 
 
-def _general_sync_body(cfg, attack, model, opt, l_per_dev):
+def _general_sync_body(cfg, attack, model, opt, l_per_dev, seq_axis=None):
     """Role-based round over single-copy global params: broadcast the global
     model into a vmapped local-SGD phase (peers diverge only transiently),
     aggregate trainer deltas, apply one deterministic server update. One
     fused program = the two phase fragments composed with no host boundary."""
-    train = _local_train_phase(cfg, attack, model, opt, l_per_dev)
+    train = _local_train_phase(cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis)
     agg = _aggregate_phase(cfg, l_per_dev)
 
     def body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
@@ -519,6 +545,47 @@ def _general_sync_body(cfg, attack, model, opt, l_per_dev):
         return new_p, kept_opt, losses
 
     return body
+
+
+def build_per_peer_eval_fn(cfg: Config, mesh: Mesh) -> Callable:
+    """Per-peer accuracy of the synchronized global model on each peer's OWN
+    local shard: ``(state, x, y) -> [num_peers]`` accuracies.
+
+    This is the reference's per-tester progress metric — each tester
+    evaluates on its own partition (reference ``evaluation/evaluation.py:10``,
+    collected per round into the HTTP response at ``main.py:86-109``). The
+    held-out global eval (``build_eval_fn``) remains the headline metric;
+    this one exists for API parity and per-peer observability."""
+    model = build_model(cfg)
+    forward = make_forward_fn(model, jnp.dtype(cfg.compute_dtype))
+    peer_params = params_layout(cfg) == "peer"
+
+    def body(params, x, y):
+        # Works for [B, C]/[B] classifiers and [B, T, C]/[B, T] sequence
+        # models alike (argmax over the trailing class axis).
+        def acc(p, xp, yp):
+            logits = forward(p, xp)
+            return jnp.mean(jnp.argmax(logits, axis=-1) == yp)
+
+        if peer_params:
+            # Gossip: every peer evaluates its OWN model (models genuinely
+            # differ across peers between mixes).
+            return jax.vmap(acc)(params, x, y)
+        pvaried = jax.lax.pcast(params, PEER_AXIS, to="varying")
+        return jax.vmap(acc, in_axes=(None, 0, 0))(pvaried, x, y)
+
+    smapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(PEER_AXIS) if peer_params else P(), P(PEER_AXIS), P(PEER_AXIS)),
+        out_specs=P(PEER_AXIS),
+    )
+
+    @jax.jit
+    def eval_fn(state: PeerState, x, y):
+        return smapped(state.params, x, y)
+
+    return eval_fn
 
 
 def build_eval_fn(cfg: Config) -> Callable:

@@ -223,7 +223,7 @@ class Experiment:
         # starts with a clean slate, like any real failure detector).
         self.failure_cooldown_rounds = failure_cooldown_rounds
         self._suspect_until: dict[int, int] = {}
-        self.mesh = make_mesh(n_devices)
+        self.mesh = make_mesh(n_devices, seq_shards=cfg.seq_shards)
         self.data = make_federated_data(cfg)
         # Sync layouts with the trust plane on use the split (two-program)
         # round so the BRB verdict gates the aggregate between the phases;
@@ -254,10 +254,11 @@ class Experiment:
         if state is None:
             state = init_peer_state(cfg)
 
-        sh = peer_sharding(self.mesh)
+        from p2pdl_tpu.parallel.mesh import data_sharding
+
         self.state = shard_state(state, cfg, self.mesh)
-        self.x = jax.device_put(self.data.x, sh)
-        self.y = jax.device_put(self.data.y, sh)
+        self.x = jax.device_put(self.data.x, data_sharding(self.mesh))
+        self.y = jax.device_put(self.data.y, peer_sharding(self.mesh))
         byz_gate = np.zeros(cfg.num_peers, np.float32)
         for i in self.byz_ids:
             byz_gate[i] = 1.0
@@ -419,6 +420,26 @@ class Experiment:
         if self.checkpointer is not None and (r + 1) % self.checkpoint_every == 0:
             self.checkpointer.save(self.state, self.cfg, extra=self._ckpt_extra)
         return record
+
+    def per_peer_accuracy(self) -> np.ndarray:
+        """Accuracy of the current model per peer on that peer's OWN shard —
+        the reference's per-tester progress metric (its testers evaluate on
+        their own partitions, reference ``evaluation/evaluation.py:10``,
+        surfaced per round over HTTP at ``main.py:86-109``). Built lazily:
+        only the HTTP facade (and whoever asks) pays for it."""
+        r = int(self.state.round_idx)
+        cached = getattr(self, "_per_peer_cache", None)
+        if cached is not None and cached[0] == r:
+            return cached[1]
+        if not hasattr(self, "_per_peer_eval"):
+            from p2pdl_tpu.parallel import build_per_peer_eval_fn
+
+            self._per_peer_eval = build_per_peer_eval_fn(self.cfg, self.mesh)
+        accs = np.asarray(self._per_peer_eval(self.state, self.x, self.y))
+        # Cached per round: the reference flow queries each tester in turn
+        # (``main.py:87``) — that must not relaunch the mesh-wide eval N times.
+        self._per_peer_cache = (r, accs)
+        return accs
 
     def save_checkpoint(self) -> None:
         """Checkpoint the current state (no-op without a dir; idempotent —

@@ -219,6 +219,27 @@ def test_gossip_learns_and_contracts(base_cfg, mesh8):
     assert np.isfinite(spread)
 
 
+def test_gossip_lstm_round_runs(mesh8):
+    """The Shakespeare-LSTM gossip benchmark config's shape: the LSTM's
+    scan carry must type-check inside shard_map (vma: a fresh zero carry is
+    invariant, the body makes it peer-varying — regression for the carry
+    pcast in models/lstm.py)."""
+    cfg = Config(
+        num_peers=8,
+        trainers_per_round=8,
+        local_epochs=1,
+        samples_per_peer=8,
+        batch_size=4,
+        model="char_lstm",
+        dataset="shakespeare",
+        aggregator="gossip",
+        seq_len=16,
+    )
+    _, losses, ev = _run_rounds(cfg, mesh8, n_rounds=2)
+    assert np.isfinite(losses).all()
+    assert np.isfinite(ev["eval_loss"])
+
+
 def test_secure_fedavg_matches_plain_fedavg(base_cfg, mesh8):
     """Pairwise masks must cancel exactly in the aggregate: same learning
     trajectory as plain fedavg up to float tolerance."""
