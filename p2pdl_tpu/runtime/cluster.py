@@ -72,10 +72,13 @@ class Node:
         self.cluster._mark_trainer(self.node_id)
 
     def testing(self) -> dict[str, Any]:
-        ev = self.cluster.last_record
-        if ev is None:
+        """This node's accuracy on ITS OWN shard — exactly the reference's
+        tester metric (``evaluation/evaluation.py:20-24`` evaluates the
+        node's partition and returns ``{accuracy, addr, port}``)."""
+        if self.cluster.last_record is None:
             raise RuntimeError("no round has run yet")
-        return {"accuracy": ev.eval_acc, "addr": self.addr, "port": self.port}
+        acc = self.cluster.experiment.per_peer_accuracy()[self.node_id]
+        return {"accuracy": float(acc), "addr": self.addr, "port": self.port}
 
 
 class Cluster:
@@ -126,6 +129,17 @@ class Cluster:
         for node in self.nodes:
             if node.node_id not in failed:
                 node._delivered.set()
+
+    def per_node_results(self, node_ids: Optional[list[int]] = None) -> list[dict[str, Any]]:
+        """Per-node ``{accuracy, addr, port}`` on each node's own shard
+        (the reference's per-tester entries in the HTTP learning progress,
+        ``main.py:86-94``); defaults to every node."""
+        accs = self.experiment.per_peer_accuracy()
+        nodes = self.nodes if node_ids is None else [self.nodes[i] for i in node_ids]
+        return [
+            {"accuracy": float(accs[n.node_id]), "addr": n.addr, "port": n.port}
+            for n in nodes
+        ]
 
     def run_round(self, trainers: Optional[list[int]] = None) -> RoundRecord:
         """Drive one full round directly (the orchestration in

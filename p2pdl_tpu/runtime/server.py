@@ -29,7 +29,9 @@ class OrchestratorState:
 
     def start_training(self) -> dict:
         """Run ``cfg.rounds`` rounds; returns learning progress per round
-        (reference ``main.py:96-109`` shape: accuracy per node per round)."""
+        (reference ``main.py:96-109`` shape: per-TESTER ``{accuracy, addr,
+        port}`` entries under ``results``, each tester's accuracy measured
+        on its own shard, plus our held-out global metrics)."""
         with self.lock:
             if self.training:
                 return {"error": "training already in progress"}
@@ -38,6 +40,11 @@ class OrchestratorState:
             progress = []
             for _ in range(self.cfg.rounds):
                 record = self.cluster.run_round()
+                testers = [
+                    i
+                    for i in range(self.cfg.num_peers)
+                    if i not in record.trainers
+                ]
                 progress.append(
                     {
                         "round": record.round,
@@ -45,6 +52,7 @@ class OrchestratorState:
                         "train_loss": record.train_loss,
                         "eval_loss": record.eval_loss,
                         "accuracy": record.eval_acc,
+                        "results": self.cluster.per_node_results(testers),
                         "duration_s": record.duration_s,
                         "brb_delivered": record.brb_delivered,
                     }

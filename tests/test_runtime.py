@@ -122,7 +122,15 @@ def test_http_server_endpoints(small_cfg, mesh8):
             result = json.loads(r.read())
         assert result["status"] == "completed"
         assert len(result["learning_progress"]) == 1
-        assert "accuracy" in result["learning_progress"][0]
+        entry = result["learning_progress"][0]
+        assert "accuracy" in entry
+        # Per-tester results (reference ``main.py:86-109``): one
+        # {accuracy, addr, port} per NON-trainer, accuracy on its own shard.
+        testers = [i for i in range(8) if i not in entry["trainers"]]
+        assert len(entry["results"]) == len(testers)
+        for res in entry["results"]:
+            assert set(res) == {"accuracy", "addr", "port"}
+            assert 0.0 <= res["accuracy"] <= 1.0
 
         with urllib.request.urlopen(f"http://127.0.0.1:{port}/status", timeout=10) as r:
             status = json.loads(r.read())
@@ -213,6 +221,25 @@ def test_failure_detection_excludes_peer_from_sampling(small_cfg, mesh8):
     # Re-admitted exactly after the cooldown: eligible from round r+4 on
     # (eligibility is suspect_until < round_idx).
     assert exp._suspect_until[dead] < r + 4
+
+
+def test_per_peer_accuracy_distinguishes_peers(mesh8):
+    """per_peer_accuracy returns one value per peer, measured on each peer's
+    own shard; after training on a non-IID split the values differ (one
+    global accuracy cannot fake it)."""
+    cfg = Config(
+        num_peers=8, trainers_per_round=8, rounds=3, local_epochs=2,
+        samples_per_peer=32, batch_size=32, lr=0.05, server_lr=1.0,
+        partition="dirichlet", dirichlet_alpha=0.3,
+    )
+    exp = Experiment(cfg)
+    for _ in range(3):
+        exp.run_round()
+    accs = exp.per_peer_accuracy()
+    assert accs.shape == (8,)
+    assert np.isfinite(accs).all()
+    assert (accs >= 0).all() and (accs <= 1).all()
+    assert len(np.unique(np.round(accs, 4))) > 1, "all peers identical"
 
 
 def test_multihost_single_process_topology(mesh8):
