@@ -168,7 +168,17 @@ class Checkpointer:
 
 # Config fields that do not shape the checkpointed state and so may change
 # across a resume (e.g. raising ``rounds`` to extend a finished experiment).
-RESUME_COMPATIBLE_FIELDS = ("rounds", "round_timeout_s", "brb_enabled")
+# attn_impl / robust_impl / seq_shards choose numerically-equivalent
+# execution strategies over the same params; vit_pool is NOT here — it
+# changes the param structure (CLS token + position-table size).
+RESUME_COMPATIBLE_FIELDS = (
+    "rounds",
+    "round_timeout_s",
+    "brb_enabled",
+    "attn_impl",
+    "robust_impl",
+    "seq_shards",
+)
 
 # Bumped when the PeerState pytree layout changes (v2: sync-layout params are
 # a single global copy). An identical Config can describe either layout, so

@@ -72,6 +72,18 @@ def test_resume_allows_extended_rounds(tmp_path):
     assert _trees_equal(state.params, restored.params)
 
 
+def test_resume_allows_execution_strategy_changes(tmp_path):
+    """Execution-strategy knobs (robust_impl, attn_impl, seq_shards) pick
+    numerically-equivalent schedules over the same state — switching them
+    across a resume must not be rejected."""
+    state = init_peer_state(TINY)
+    ck = Checkpointer(str(tmp_path / "ckpt"))
+    ck.save(state, TINY)
+    changed = TINY.replace(robust_impl="gathered")
+    restored = ck.restore(changed)
+    assert _trees_equal(state.params, restored.params)
+
+
 def test_resume_rejects_different_attack(tmp_path):
     """A Byzantine run's checkpoint must not silently continue as honest:
     attack/byz_ids are Experiment args (not Config fields) but are saved and
