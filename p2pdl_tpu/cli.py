@@ -40,6 +40,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trimmed-mean-beta", type=float, default=0.1)
     p.add_argument("--multi-krum-m", type=int, default=0)
     p.add_argument(
+        "--secure-agg-neighbors",
+        type=int,
+        default=0,
+        help="secure_fedavg mask graph: 0 = all trainer pairs (Bonawitz), "
+        "k = k-regular ring graph (Bell et al.; scales to 1024+ trainers)",
+    )
+    p.add_argument(
         "--robust-impl",
         choices=["blockwise", "gathered"],
         default="blockwise",
@@ -119,6 +126,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         trimmed_mean_beta=args.trimmed_mean_beta,
         multi_krum_m=args.multi_krum_m,
         robust_impl=args.robust_impl,
+        secure_agg_neighbors=args.secure_agg_neighbors,
         brb_enabled=args.brb,
         round_timeout_s=args.round_timeout_s,
         seed=args.seed,

@@ -77,6 +77,11 @@ class Config:
     # update stack (O(peers x model) per device — simple, fine at small
     # scale, kept as the equivalence oracle).
     robust_impl: str = "blockwise"
+    # secure_fedavg mask graph: 0 = every trainer pair (Bonawitz et al. 2017;
+    # O(T^2 x model) PRNG per round — fine to ~100 trainers), k > 0 = the
+    # k-regular ring graph (Bell et al. 2020; O(T x k x model), scales to
+    # 1024+ trainers; privacy holds unless all k neighbors collude).
+    secure_agg_neighbors: int = 0
 
     # Trust plane (read by the host-side round driver/protocol layer; the
     # compiled round function itself is trust-agnostic).
@@ -150,6 +155,17 @@ class Config:
                     "supported (the split-round digest path assumes a 1-D "
                     "peer mesh)"
                 )
+        if self.secure_agg_neighbors < 0:
+            raise ValueError(
+                f"secure_agg_neighbors must be >= 0, got {self.secure_agg_neighbors}"
+            )
+        if self.secure_agg_neighbors % 2 != 0:
+            # The ring graph pairs +/- d per side; an odd request would
+            # silently round down and overstate the collusion threshold.
+            raise ValueError(
+                f"secure_agg_neighbors must be even (k/2 ring partners per "
+                f"side), got {self.secure_agg_neighbors}"
+            )
         if self.robust_impl not in ("blockwise", "gathered"):
             raise ValueError(
                 f"unknown robust_impl {self.robust_impl!r}; one of ('blockwise', 'gathered')"

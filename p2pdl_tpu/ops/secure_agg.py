@@ -13,6 +13,15 @@ Scope (documented limitation vs. the full protocol): pairwise keys come from
 a shared experiment key rather than a Diffie-Hellman exchange, and there is
 no dropout-recovery secret-sharing — cancellation assumes the round's trainer
 set completes, which the round driver guarantees in simulation.
+
+Scaling: the full Bonawitz graph costs O(T x model) PRNG *per trainer* —
+O(T^2 x model) per round, which is infeasible at T = 1024 on any hardware
+(~10^13 random draws per round for ViT-Tiny). ``neighbors = k`` switches to
+the k-regular ring graph of Bell et al. (CCS 2020): each trainer exchanges
+masks with its k ring neighbors in the sorted trainer list, masks still
+cancel exactly (position-symmetric pairs), and per-round cost drops to
+O(T x k x model) with privacy degrading gracefully (an update is hidden
+unless all k of its neighbors collude with the server).
 """
 
 from __future__ import annotations
@@ -29,15 +38,47 @@ def pairwise_mask(
     my_id: jax.Array,
     trainer_ids: jnp.ndarray,
     tree: Any,
+    neighbors: int = 0,
 ) -> Any:
-    """The net mask trainer ``my_id`` adds: ``sum_j sign(j - i) * PRF(i, j)``.
+    """The net mask trainer ``my_id`` adds: ``sum_j sign(j - i) * PRF(i, j)``
+    over its mask partners.
 
-    ``trainer_ids``: ``[T]`` global peer ids of this round's trainers. The
+    ``trainer_ids``: ``[T]`` global peer ids of this round's trainers.
+    ``neighbors = 0`` pairs with every other trainer (Bonawitz full graph);
+    ``neighbors = k`` pairs with the k ring neighbors at offsets
+    ``+/-1..k//2`` in the trainer vector (Bell-style k-regular graph). The
     PRF key for a pair is order-independent (``fold_in(min) -> fold_in(max)``)
     so both endpoints derive the same mask; ``sign`` is antisymmetric and
     zero for ``j == i`` (self-pair contributes nothing). Returns a pytree
     shaped like ``tree``.
     """
+    t = trainer_ids.shape[0]
+    if neighbors and neighbors < t - 1:
+        # Ring pairing over the LIVE trainers only, by rank among live
+        # entries (symmetric: offset +d from rank p lands on rank q iff
+        # offset -d from q lands on p), so both endpoints of every pair
+        # include it — cancellation holds. Ranking over live entries (not
+        # raw positions) matters: with -1 vacancy gating in place, a trainer
+        # whose positional neighbors were all gated out would otherwise get
+        # a ZERO mask and enter the "secure" aggregate in plaintext.
+        live = trainer_ids >= 0  # [T]
+        t_idx = jnp.arange(t)
+        my_pos = jnp.argmax(trainer_ids == my_id)
+        my_rank = jnp.sum(live & (t_idx < my_pos))
+        n_live = jnp.maximum(jnp.sum(live), 1)
+        # Live ids first, in positional order (vacancies pushed to the end).
+        order = jnp.argsort(jnp.where(live, t_idx, t + t_idx))
+        live_first = trainer_ids[order]
+        half = neighbors // 2
+        offsets = jnp.concatenate(
+            [jnp.arange(1, half + 1), -jnp.arange(1, half + 1)]
+        )
+        partners = live_first[(my_rank + offsets) % n_live]
+        # When n_live <= neighbors the ring wraps onto my_id itself —
+        # sign(0) = 0 keeps self-pairs inert; duplicated pairs stay
+        # symmetric at both endpoints and still cancel.
+    else:
+        partners = trainer_ids
     leaves, treedef = jax.tree.flatten(tree)
 
     def mask_for_leaf(leaf_idx: int, leaf: jnp.ndarray) -> jnp.ndarray:
@@ -58,7 +99,7 @@ def pairwise_mask(
         # Derive the accumulator from the leaf (not a fresh zeros) so its
         # varying-manual-axes type matches inside shard_map scans.
         acc0 = (leaf * 0).astype(jnp.float32)
-        out, _ = lax.scan(body, acc0, trainer_ids)
+        out, _ = lax.scan(body, acc0, partners)
         return out.astype(leaf.dtype)
 
     masks = [mask_for_leaf(i, l) for i, l in enumerate(leaves)]
@@ -71,9 +112,10 @@ def apply_masks(
     my_id: jax.Array,
     trainer_ids: jnp.ndarray,
     is_trainer: jax.Array,
+    neighbors: int = 0,
 ) -> Any:
     """Add this peer's net pairwise mask to its delta (no-op for non-trainers)."""
-    mask = pairwise_mask(base_key, my_id, trainer_ids, deltas)
+    mask = pairwise_mask(base_key, my_id, trainer_ids, deltas, neighbors=neighbors)
     gate = is_trainer.astype(jnp.float32)
 
     def leaf(d, m):

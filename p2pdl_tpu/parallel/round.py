@@ -473,7 +473,10 @@ def _aggregate_phase(cfg, l_per_dev):
 
         if cfg.aggregator == "secure_fedavg":
             delta = jax.vmap(
-                lambda d, pid, it: apply_masks(d, mask_key, pid, trainer_idx, it)
+                lambda d, pid, it: apply_masks(
+                    d, mask_key, pid, trainer_idx, it,
+                    neighbors=cfg.secure_agg_neighbors,
+                )
             )(delta, local_ids, is_trainer)
 
         if cfg.aggregator in ("fedavg", "secure_fedavg"):

@@ -178,6 +178,7 @@ RESUME_COMPATIBLE_FIELDS = (
     "attn_impl",
     "robust_impl",
     "seq_shards",
+    "secure_agg_neighbors",
 )
 
 # Bumped when the PeerState pytree layout changes (v2: sync-layout params are

@@ -73,15 +73,30 @@ def test_resume_allows_extended_rounds(tmp_path):
 
 
 def test_resume_allows_execution_strategy_changes(tmp_path):
-    """Execution-strategy knobs (robust_impl, attn_impl, seq_shards) pick
-    numerically-equivalent schedules over the same state — switching them
-    across a resume must not be rejected."""
+    """Execution-strategy knobs pick numerically-equivalent schedules over
+    the same state — switching any of them across a resume must not be
+    rejected."""
     state = init_peer_state(TINY)
     ck = Checkpointer(str(tmp_path / "ckpt"))
     ck.save(state, TINY)
-    changed = TINY.replace(robust_impl="gathered")
-    restored = ck.restore(changed)
-    assert _trees_equal(state.params, restored.params)
+    for change in (
+        {"robust_impl": "gathered"},
+        {"attn_impl": "flash", "model": "vit_tiny", "dataset": "cifar10"},
+        {"secure_agg_neighbors": 8},
+    ):
+        if "model" in change:
+            continue  # model changes state shape; attn_impl covered below
+        restored = ck.restore(TINY.replace(**change))
+        assert _trees_equal(state.params, restored.params)
+    # attn_impl on its own valid config (flash requires vit_tiny, which is a
+    # different state shape — so exercise it with a vit checkpoint).
+    vit = TINY.replace(model="vit_tiny", dataset="cifar10", vit_pool="mean")
+    vit_state = init_peer_state(vit)
+    ck2 = Checkpointer(str(tmp_path / "vit"))
+    ck2.save(vit_state, vit)
+    for change in ({"attn_impl": "flash"}, {"seq_shards": 2}):
+        restored = ck2.restore(vit.replace(**change))
+        assert _trees_equal(vit_state.params, restored.params)
 
 
 def test_resume_rejects_different_attack(tmp_path):

@@ -240,11 +240,16 @@ def test_gossip_lstm_round_runs(mesh8):
     assert np.isfinite(ev["eval_loss"])
 
 
-def test_secure_fedavg_matches_plain_fedavg(base_cfg, mesh8):
-    """Pairwise masks must cancel exactly in the aggregate: same learning
-    trajectory as plain fedavg up to float tolerance."""
-    cfg_plain = base_cfg.replace(trainers_per_round=4)
-    cfg_sec = cfg_plain.replace(aggregator="secure_fedavg")
+@pytest.mark.parametrize("neighbors", [0, 4])
+def test_secure_fedavg_matches_plain_fedavg(base_cfg, mesh8, neighbors):
+    """Pairwise masks must cancel exactly in the aggregate — for the full
+    Bonawitz graph (neighbors=0) AND the scalable k-regular ring graph
+    (Bell et al.): same learning trajectory as plain fedavg up to float
+    tolerance."""
+    cfg_plain = base_cfg.replace(trainers_per_round=6)
+    cfg_sec = cfg_plain.replace(
+        aggregator="secure_fedavg", secure_agg_neighbors=neighbors
+    )
     _, l_plain, e_plain = _run_rounds(cfg_plain, mesh8, n_rounds=2)
     _, l_sec, e_sec = _run_rounds(cfg_sec, mesh8, n_rounds=2)
     # Masks cancel exactly in infinite precision; float32 summation leaves

@@ -46,6 +46,63 @@ def test_pair_masks_are_symmetric():
     np.testing.assert_allclose(np.asarray(m2), -np.asarray(m5), rtol=1e-6)
 
 
+def test_neighbor_masks_cancel_and_hide():
+    """The k-regular ring graph (Bell et al.): masks still cancel exactly in
+    the sum, every update is still hidden, and the per-trainer mask work is
+    k partners — not T."""
+    t = 9
+    deltas = _deltas(t, seed=3)
+    base = jax.random.PRNGKey(11)
+    trainer_ids = jnp.arange(t, dtype=jnp.int32)
+    masked = jax.vmap(
+        lambda d, pid: apply_masks(
+            {"w": d}, base, pid, trainer_ids, jnp.bool_(True), neighbors=4
+        )
+    )(deltas, trainer_ids)["w"]
+    np.testing.assert_allclose(
+        np.asarray(masked.sum(0)), np.asarray(deltas.sum(0)), rtol=1e-4, atol=1e-4
+    )
+    diff = np.abs(np.asarray(masked) - np.asarray(deltas)).mean(axis=1)
+    assert (diff > 0.1).all(), f"masks too weak: {diff}"
+
+
+def test_neighbor_masks_cancel_with_vacancies():
+    """-1 vacancy padding (gated/shrunken rounds) must not break ring-graph
+    cancellation: phantom pairs are zeroed at both real endpoints."""
+    live = jnp.asarray([0, 2, 5, 7, 8], jnp.int32)
+    padded = jnp.concatenate([live, jnp.asarray([-1, -1], jnp.int32)])
+    deltas = _deltas(5, seed=4)
+    base = jax.random.PRNGKey(12)
+    masked = jax.vmap(
+        lambda d, pid: apply_masks(
+            {"w": d}, base, pid, padded, jnp.bool_(True), neighbors=4
+        )
+    )(deltas, live)["w"]
+    np.testing.assert_allclose(
+        np.asarray(masked.sum(0)), np.asarray(deltas.sum(0)), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_neighbor_masks_never_degrade_to_plaintext():
+    """A trainer whose POSITIONAL ring neighbors were all gated to -1 (BRB
+    in-place gating) must still be masked: partner selection ranks over live
+    trainers, so no live update ever enters the aggregate in plaintext."""
+    gated = jnp.asarray([0, -1, 2, -1, 4, 5], jnp.int32)
+    live = jnp.asarray([0, 2, 4, 5], jnp.int32)
+    deltas = _deltas(4, seed=5)
+    base = jax.random.PRNGKey(13)
+    masked = jax.vmap(
+        lambda d, pid: apply_masks(
+            {"w": d}, base, pid, gated, jnp.bool_(True), neighbors=2
+        )
+    )(deltas, live)["w"]
+    np.testing.assert_allclose(
+        np.asarray(masked.sum(0)), np.asarray(deltas.sum(0)), rtol=1e-4, atol=1e-4
+    )
+    diff = np.abs(np.asarray(masked) - np.asarray(deltas)).mean(axis=1)
+    assert (diff > 0.1).all(), f"a live update went unmasked: {diff}"
+
+
 def test_non_trainer_unmasked():
     base = jax.random.PRNGKey(1)
     d = {"w": jnp.ones((8,))}
