@@ -92,8 +92,13 @@ def bench_config(
     attack: str = "none",
     byz_ids: tuple[int, ...] = (),
     timed_rounds: int = 20,
+    fused_rounds: int = 0,
 ) -> float:
-    """Rounds/sec of the compiled federated round for one config."""
+    """Rounds/sec of the compiled federated round for one config.
+
+    ``fused_rounds > 0`` benchmarks the multi-round program (R rounds per
+    dispatch via an on-device ``lax.scan``) — the high-throughput mode for
+    dispatch-bound configs."""
     mesh = make_mesh()
     data = make_federated_data(cfg, eval_samples=16)
     state = shard_state(init_peer_state(cfg), cfg, mesh)
@@ -101,7 +106,6 @@ def bench_config(
     x = jax.device_put(data.x, sh)
     y = jax.device_put(data.y, sh)
 
-    round_fn = build_round_fn(cfg, mesh, attack=attack)
     rng = np.random.default_rng(cfg.seed)
     trainer_idx = jnp.asarray(
         np.sort(rng.choice(cfg.num_peers, cfg.trainers_per_round, replace=False)),
@@ -113,6 +117,23 @@ def bench_config(
     byz = jnp.asarray(byz)
     key = jax.random.PRNGKey(0)
 
+    if fused_rounds > 0:
+        from p2pdl_tpu.parallel import build_multi_round_fn
+
+        multi_fn = build_multi_round_fn(cfg, mesh, attack=attack)
+        trainer_mat = jnp.broadcast_to(
+            trainer_idx, (fused_rounds, cfg.trainers_per_round)
+        )
+        state, m = multi_fn(state, x, y, trainer_mat, byz, key)  # compile
+        jax.block_until_ready(m["train_loss"])
+        calls = max(1, timed_rounds // fused_rounds)
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            state, m = multi_fn(state, x, y, trainer_mat, byz, key)
+        jax.block_until_ready(m["train_loss"])
+        return calls * fused_rounds / (time.perf_counter() - t0)
+
+    round_fn = build_round_fn(cfg, mesh, attack=attack)
     # Warmup / compile.
     state, m = round_fn(state, x, y, trainer_idx, byz, key)
     jax.block_until_ready(m["train_loss"])
@@ -290,6 +311,26 @@ def run_matrix(timed_rounds: int = 10) -> list[dict]:
                 attack=e.get("attack", "none"),
                 byz_ids=e.get("byz_ids", ()),
                 timed_rounds=timed_rounds,
+            ),
+            name,
+        )
+        rec = (
+            {"metric": name, "value": round(value, 3), "unit": "rounds/sec"}
+            if value is not None
+            else err
+        )
+        print(json.dumps(rec), flush=True)
+        results.append(rec)
+        flush()
+
+    # Fused multi-round mode (R rounds per dispatch): how much of the
+    # small-config round time was host dispatch.
+    for fused in (16,):
+        entry = matrix_entries()[0]  # mnist_mlp_8peers_fedavg
+        name = f"agg_rounds_per_sec_{entry['name']}_fused{fused}"
+        value, err = _with_retry(
+            lambda e=entry, f=fused: bench_config(
+                e["cfg"], timed_rounds=64, fused_rounds=f
             ),
             name,
         )

@@ -86,6 +86,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=1, help="rounds between checkpoints")
     p.add_argument("--profile-dir", default=None, help="jax.profiler trace output dir")
     p.add_argument(
+        "--fused-rounds",
+        type=int,
+        default=0,
+        help="high-throughput mode: scan N rounds per device dispatch "
+        "(requires --brb off); 0 = one round per dispatch",
+    )
+    p.add_argument(
         "--failure-cooldown",
         type=int,
         default=0,
@@ -215,9 +222,15 @@ def main(argv: list[str] | None = None) -> int:
         profile_dir=args.profile_dir, failure_cooldown_rounds=args.failure_cooldown,
     )
     with exp.profiler.trace():
-        while int(exp.state.round_idx) < cfg.rounds:
-            record = exp.run_round()
-            print(json.dumps(record.to_dict()))
+        if args.fused_rounds > 0:
+            exp.run_fused(
+                rounds_per_call=args.fused_rounds,
+                on_record=lambda rec: print(json.dumps(rec.to_dict()), flush=True),
+            )
+        else:
+            while int(exp.state.round_idx) < cfg.rounds:
+                record = exp.run_round()
+                print(json.dumps(record.to_dict()))
     exp.save_checkpoint()
     print(json.dumps({"profile": exp.profiler.summary()}))
     return 0

@@ -19,6 +19,7 @@ from p2pdl_tpu.parallel.peer_state import (
 )
 from p2pdl_tpu.parallel.round import (
     build_eval_fn,
+    build_multi_round_fn,
     build_per_peer_eval_fn,
     build_round_fn,
     build_trust_round_fns,
@@ -34,6 +35,7 @@ __all__ = [
     "global_params",
     "params_layout",
     "build_round_fn",
+    "build_multi_round_fn",
     "build_trust_round_fns",
     "build_eval_fn",
     "build_per_peer_eval_fn",

@@ -283,6 +283,95 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
     return jax.jit(round_fn, donate_argnums=(0,))
 
 
+def build_multi_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
+    """Compile R rounds as ONE device program: ``(state, x, y, trainer_mat
+    [R, T], byz_gate, base_key) -> (state', {"train_loss": [R, P]})``.
+
+    A ``lax.scan`` over rounds inside the same ``shard_map`` — the
+    round-loop boundary costs zero host round-trips, so configs whose
+    per-round work is small (the 8/128-peer stages, gossip rings) stop being
+    dispatch-bound. Role sampling stays on the host (``trainer_mat`` row per
+    round, same sampler as the sequential driver); per-round mask/attack
+    keys derive on device by folding ``base_key`` with the round index, and
+    the per-peer PRNG path is identical to the sequential round (the body
+    folds each peer key with the absolute round index), so R fused rounds
+    equal R sequential rounds exactly (test-asserted).
+
+    The trust plane needs the host between training and aggregation, so
+    fusion requires ``brb_enabled=False``.
+    """
+    if cfg.brb_enabled:
+        raise ValueError("fused rounds cannot host the BRB trust plane between phases")
+    seq_axis = SEQ_AXIS if cfg.seq_shards > 1 else None
+    if seq_axis is not None and SEQ_AXIS not in mesh.shape:
+        raise ValueError(
+            f"cfg.seq_shards={cfg.seq_shards} needs a (peers x seq) mesh; "
+            f"build it with make_mesh(seq_shards=...)"
+        )
+    model = build_model(cfg, seq_axis=seq_axis)
+    opt = make_optimizer(cfg)
+    l_per_dev = peers_per_device(cfg.num_peers, mesh)
+    if params_layout(cfg) == "peer":
+        body = _gossip_body(cfg, mesh, attack, model, opt, l_per_dev, emit_delta=False)
+        params_spec = P(PEER_AXIS)
+    elif _use_fast_sync_path(cfg, attack):
+        body = _fast_sync_body(cfg, model, l_per_dev)
+        params_spec = P()
+    else:
+        body = _general_sync_body(cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis)
+        params_spec = P()
+
+    def multi_body(params, opt_state, rng, x, y, trainer_mat, byz_gate, round0, base_key):
+        def step(carry, inputs):
+            params, opt_state = carry
+            trainer_idx, r = inputs
+            # Absolute round index — identical mask/attack keys to the
+            # sequential driver's fold_in(base, round_idx).
+            mask_key = jax.random.fold_in(base_key, round0 + r)
+            new_p, new_opt, losses = body(
+                params, opt_state, rng, x, y, trainer_idx, byz_gate, round0 + r, mask_key
+            )
+            return (new_p, new_opt), losses
+
+        rounds = trainer_mat.shape[0]
+        (params, opt_state), losses = lax.scan(
+            step, (params, opt_state), (trainer_mat, jnp.arange(rounds))
+        )
+        return params, opt_state, losses  # losses: [R, L]
+
+    sp = P(PEER_AXIS)
+    sr = P()
+    x_spec = P(PEER_AXIS, None, SEQ_AXIS) if seq_axis is not None else sp
+    smapped = jax.shard_map(
+        multi_body,
+        mesh=mesh,
+        in_specs=(params_spec, sp, sp, x_spec, sp, sr, sr, sr, sr),
+        out_specs=(params_spec, sp, P(None, PEER_AXIS)),
+    )
+
+    def multi_round_fn(state: PeerState, x, y, trainer_mat, byz_gate, base_key):
+        new_params, new_opt, losses = smapped(
+            state.params,
+            state.opt_state,
+            state.rng,
+            x,
+            y,
+            trainer_mat,
+            byz_gate,
+            state.round_idx,
+            base_key,
+        )
+        new_state = PeerState(
+            params=new_params,
+            opt_state=new_opt,
+            rng=state.rng,
+            round_idx=state.round_idx + trainer_mat.shape[0],
+        )
+        return new_state, {"train_loss": losses}
+
+    return jax.jit(multi_round_fn, donate_argnums=(0,))
+
+
 def build_trust_round_fns(cfg: Config, mesh: Mesh, attack: str = "none") -> tuple[Callable, Callable]:
     """The BRB-gated round: local training and aggregation as two compiled
     programs with the host trust plane deciding between them which trainers'

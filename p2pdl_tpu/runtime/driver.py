@@ -51,8 +51,10 @@ class RoundRecord:
     round: int
     trainers: list[int]
     train_loss: float
-    eval_loss: float
-    eval_acc: float
+    # None (-> JSON null) on interior rounds of a fused block, where held-out
+    # eval intentionally does not run (see Experiment.run_fused).
+    eval_loss: Optional[float]
+    eval_acc: Optional[float]
     duration_s: float
     brb_delivered: Optional[int] = None  # peers that delivered all trainer broadcasts
     brb_failed_peers: Optional[list[int]] = None
@@ -448,6 +450,74 @@ class Experiment:
             self.state.round_idx
         ):
             self.checkpointer.save(self.state, self.cfg, extra=self._ckpt_extra)
+
+    def run_fused(
+        self,
+        rounds_per_call: int = 8,
+        on_record: Optional[Any] = None,
+    ) -> list[RoundRecord]:
+        """High-throughput mode: scan ``rounds_per_call`` rounds per device
+        dispatch (``parallel.build_multi_round_fn``) — zero host round-trips
+        at round boundaries, so small-per-round configs stop being
+        dispatch-bound. Requires the trust plane off (it must interpose
+        between training and aggregation). Role sampling, losses, metrics,
+        and checkpoint cadence are per round exactly as in :meth:`run`;
+        held-out eval runs once per BLOCK (recorded on the block's last
+        round, ``None`` -> JSON null on interior rounds — evaluating interior
+        rounds would re-serialize the device loop this mode exists to
+        remove). ``on_record`` is called with each RoundRecord as blocks
+        complete (per-block streaming for CLI/monitoring)."""
+        if self.trust is not None:
+            raise ValueError("run_fused requires brb_enabled=False")
+        from p2pdl_tpu.parallel import build_multi_round_fn
+
+        if not hasattr(self, "_multi_round_fn"):
+            self._multi_round_fn = build_multi_round_fn(
+                self.cfg, self.mesh, attack=self.attack
+            )
+        base_key = jax.random.PRNGKey(self.cfg.seed)
+        while int(self.state.round_idx) < self.cfg.rounds:
+            r0 = int(self.state.round_idx)
+            block = min(rounds_per_call, self.cfg.rounds - r0)
+            trainer_mat = np.stack([self.sample_roles(r0 + i) for i in range(block)])
+            t0 = time.perf_counter()
+            with self.profiler.phase("round"):
+                self.state, m = self._multi_round_fn(
+                    self.state,
+                    self.x,
+                    self.y,
+                    jnp.asarray(trainer_mat, jnp.int32),
+                    self.byz_gate,
+                    base_key,
+                )
+                losses = np.asarray(m["train_loss"])  # [R, P]
+            dt = (time.perf_counter() - t0) / block
+            with self.profiler.phase("eval"):
+                ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)
+            for i in range(block):
+                live = trainer_mat[i][trainer_mat[i] >= 0]
+                row = losses[i] if self.cfg.aggregator == "gossip" else losses[i][live]
+                last = i == block - 1
+                record = RoundRecord(
+                    round=r0 + i,
+                    trainers=live.tolist(),
+                    train_loss=float(np.mean(row)),
+                    eval_loss=float(ev["eval_loss"]) if last else None,
+                    eval_acc=float(ev["eval_acc"]) if last else None,
+                    duration_s=dt,
+                )
+                self.records.append(record)
+                self.metrics.log(record.to_dict())
+                if on_record is not None:
+                    on_record(record)
+            # Same cadence as run(): save iff a checkpoint_every boundary
+            # was crossed inside this block (at most one save per block).
+            if self.checkpointer is not None and (
+                (r0 + block) // self.checkpoint_every > r0 // self.checkpoint_every
+            ):
+                self.checkpointer.save(self.state, self.cfg, extra=self._ckpt_extra)
+        self.save_checkpoint()
+        return self.records
 
     def run(self) -> list[RoundRecord]:
         """Run the remaining rounds (resume-aware: a restored experiment
