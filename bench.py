@@ -260,6 +260,17 @@ def matrix_entries() -> list[dict]:
             ),
         },
         {
+            # End-to-end fused-attention round: the Pallas kernels compiled
+            # by Mosaic inside the full federated round (the microbench
+            # below times the kernels in isolation).
+            "name": "cifar10_vit_flash_8peers_fedavg",
+            "cfg": Config(
+                num_peers=8, trainers_per_round=4, local_epochs=1,
+                samples_per_peer=16, batch_size=16, model="vit_tiny",
+                dataset="cifar10", attn_impl="flash",
+            ),
+        },
+        {
             "name": "cifar10_cnn_1024peers_krum_blockwise",
             "cfg": Config(
                 num_peers=1024, trainers_per_round=64, local_epochs=1,
