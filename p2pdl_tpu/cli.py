@@ -79,6 +79,20 @@ def build_parser() -> argparse.ArgumentParser:
         default="cls",
         help="ViT head pooling (mean required under --seq-shards > 1)",
     )
+    p.add_argument(
+        "--vit-heads",
+        type=int,
+        default=3,
+        help="ViT attention head count (4 divides evenly for --tp-shards "
+        "on power-of-two meshes)",
+    )
+    p.add_argument(
+        "--tp-shards",
+        type=int,
+        default=1,
+        help="tensor parallelism: shard attention heads + MLP hidden over "
+        "a mesh axis of this size (megatron column/row); 1=off",
+    )
     p.add_argument("--attack", default="none", help="Byzantine attack for injected peers")
     p.add_argument("--byz-ids", default="", help="comma-separated adversarial peer ids")
     p.add_argument("--log-path", default=None, help="JSONL metrics output")
@@ -143,6 +157,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         attn_impl=args.attn_impl,
         seq_shards=args.seq_shards,
         vit_pool=args.vit_pool,
+        vit_heads=args.vit_heads,
+        tp_shards=args.tp_shards,
     )
 
 

@@ -104,6 +104,13 @@ class Config:
     # ViT head: "cls" token (default) or "mean" pooling (required — and
     # psum-reduced — under sequence parallelism).
     vit_pool: str = "cls"
+    # ViT attention head count (3 = standard ViT-Tiny; 4 divides evenly for
+    # tensor parallelism on power-of-two meshes).
+    vit_heads: int = 3
+    # Tensor parallelism: shard attention heads + MLP hidden over a mesh
+    # axis of this size (megatron column/row decomposition, ops/tp.py).
+    # 1 = off. Requires vit_tiny, tp_shards | vit_heads, plain SGD.
+    tp_shards: int = 1
 
     def __post_init__(self) -> None:
         if self.num_peers < 2:
@@ -134,6 +141,59 @@ class Config:
             )
         if self.vit_pool not in ("cls", "mean"):
             raise ValueError(f"unknown vit_pool {self.vit_pool!r}; one of ('cls', 'mean')")
+        if self.model == "vit_tiny":
+            from p2pdl_tpu.models.vit import ViTTiny
+
+            if self.vit_heads < 1 or ViTTiny.dim % self.vit_heads != 0:
+                raise ValueError(
+                    f"vit_heads must divide the ViT-Tiny width {ViTTiny.dim}, "
+                    f"got {self.vit_heads}"
+                )
+        if self.tp_shards < 1:
+            raise ValueError(f"tp_shards must be >= 1, got {self.tp_shards}")
+        if self.tp_shards > 1:
+            if self.model != "vit_tiny":
+                raise ValueError(
+                    f"tp_shards > 1 requires a transformer (vit_tiny); "
+                    f"model={self.model!r}"
+                )
+            if self.seq_shards > 1:
+                raise ValueError(
+                    "tp_shards and seq_shards are currently exclusive "
+                    "(one extra mesh axis at a time)"
+                )
+            if self.momentum != 0.0:
+                raise ValueError(
+                    "tp_shards > 1 requires momentum=0.0 (optimizer state "
+                    "sharding over the tp axis is not yet implemented)"
+                )
+            if self.brb_enabled:
+                raise ValueError(
+                    "tp_shards > 1 with the BRB trust plane is not yet "
+                    "supported (the split-round path assumes replicated "
+                    "params)"
+                )
+            if self.aggregator == "gossip":
+                raise ValueError("tp_shards > 1 is not supported with gossip")
+            if self.aggregator in ("krum", "multi_krum"):
+                # Krum's pairwise distances need the FULL update; per-tp-shard
+                # slices would score (and possibly select) different trainers
+                # per shard. Coordinate-wise reducers (trimmed_mean/median)
+                # act per-coordinate and stay correct per slice.
+                raise ValueError(
+                    "tp_shards > 1 is not supported with distance-based "
+                    "robust reducers (krum/multi_krum); use trimmed_mean, "
+                    "median, or the fedavg family"
+                )
+            from p2pdl_tpu.models.vit import TransformerBlock, ViTTiny
+            from p2pdl_tpu.ops.tp import validate_tp_geometry
+
+            validate_tp_geometry(
+                self.vit_heads,
+                ViTTiny.dim,
+                ViTTiny.dim * TransformerBlock.mlp_ratio,
+                self.tp_shards,
+            )
         if self.seq_shards < 1:
             raise ValueError(f"seq_shards must be >= 1, got {self.seq_shards}")
         if self.seq_shards > 1:

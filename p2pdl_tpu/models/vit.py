@@ -33,17 +33,34 @@ class TransformerBlock(nn.Module):
     mlp_ratio: int = 4
     attn_impl: str = "dense"
     seq_axis: str | None = None
+    # Tensor parallelism: heads + MLP hidden sharded over this mesh axis
+    # (megatron column/row decomposition; placement in ops/tp.py).
+    # tp_shards sizes the declared features to the local slice.
+    tp_axis: str | None = None
+    tp_shards: int = 1
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if (self.tp_shards != 1) != (self.tp_axis is not None):
+            raise ValueError("tp_shards and tp_axis must be set together")
         y = nn.LayerNorm()(x)
         x = x + MultiHeadAttention(
-            self.dim, self.heads, impl=self.attn_impl, seq_axis=self.seq_axis
+            self.dim,
+            self.heads,
+            impl=self.attn_impl,
+            seq_axis=self.seq_axis,
+            tp_axis=self.tp_axis,
+            tp_shards=self.tp_shards,
         )(y)
         y = nn.LayerNorm()(x)
-        y = nn.Dense(self.dim * self.mlp_ratio)(y)
+        # Column-parallel fc1 under tp (declared width = local slice).
+        y = nn.Dense(self.dim * self.mlp_ratio // self.tp_shards)(y)
         y = nn.gelu(y)
-        y = nn.Dense(self.dim)(y)
+        y = nn.Dense(self.dim)(y)  # row-parallel under tp
+        if self.tp_axis is not None:
+            # Completes the row-parallel fc2 (its bias is pre-scaled by
+            # 1/tp_shards before apply — ops/tp.scale_row_parallel_biases).
+            y = lax.psum(y, self.tp_axis)
         return x + y
 
 
@@ -56,6 +73,8 @@ class ViTTiny(nn.Module):
     attn_impl: str = "dense"  # "flash" fuses attention via Pallas on TPU
     pool: str = "cls"  # "cls" | "mean"
     seq_axis: str | None = None  # mesh axis the token sequence is sharded on
+    tp_axis: str | None = None  # mesh axis heads/MLP-hidden are sharded on
+    tp_shards: int = 1
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -97,7 +116,12 @@ class ViTTiny(nn.Module):
 
         for _ in range(self.depth):
             x = TransformerBlock(
-                self.dim, self.heads, attn_impl=self.attn_impl, seq_axis=self.seq_axis
+                self.dim,
+                self.heads,
+                attn_impl=self.attn_impl,
+                seq_axis=self.seq_axis,
+                tp_axis=self.tp_axis,
+                tp_shards=self.tp_shards,
             )(x)
         x = nn.LayerNorm()(x)
         if self.pool == "cls":

@@ -12,6 +12,7 @@ makes blockwise attention exact.
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 
@@ -48,14 +49,33 @@ class MultiHeadAttention(nn.Module):
     causal: bool = False
     seq_axis: str | None = None
     impl: str = "dense"  # "dense" | "flash" (fused Pallas kernels)
+    # Tensor parallelism: mesh axis the heads are sharded over (inside
+    # shard_map with this module's qkv kernel column-sharded and the output
+    # kernel row-sharded — see ops/tp.py). Each shard computes its own
+    # complete heads; one psum after the output projection. ``tp_shards``
+    # sizes the DECLARED features to the local slice (flax validates param
+    # shapes at apply, so the sharded twin must declare what it receives).
+    tp_axis: str | None = None
+    tp_shards: int = 1
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if (self.tp_shards != 1) != (self.tp_axis is not None):
+            # Shard-sized features without the completing psums (or vice
+            # versa) is a silently-wrong half-width model, not an option.
+            raise ValueError("tp_shards and tp_axis must be set together")
         b, t, _ = x.shape
         head_dim = self.dim // self.heads
-        qkv = nn.Dense(3 * self.dim, use_bias=False)(x)
-        qkv = qkv.reshape(b, t, 3, self.heads, head_dim)
-        q, k, v = jnp.moveaxis(qkv, 2, 0)  # each [B, T, H, D]
+        qkv = nn.Dense(3 * self.dim // self.tp_shards, use_bias=False)(x)
+        # Infer the LOCAL head count from the tensor (under tensor
+        # parallelism the column-sharded qkv kernel yields heads/tp heads).
+        local_heads = qkv.shape[-1] // (3 * head_dim)
+        # HEAD-major feature layout (head, q|k|v, head_dim): a contiguous
+        # column slice of the qkv kernel is then exactly one shard's heads
+        # with their q, k, AND v — the property column-parallel tensor
+        # parallelism needs (a qkv-major layout would give shard 0 all of q).
+        qkv = qkv.reshape(b, t, local_heads, 3, head_dim)
+        q, k, v = jnp.moveaxis(qkv, 3, 0)  # each [B, T, H, D]
         q, k, v = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))  # [B, H, T, D]
         if self.impl not in ("dense", "flash"):
             raise ValueError(f"unknown attention impl {self.impl!r}; one of ('dense', 'flash')")
@@ -73,5 +93,12 @@ class MultiHeadAttention(nn.Module):
             out = flash_attention(q, k, v, causal=self.causal)
         else:
             out = sdpa(q, k, v, causal=self.causal)
-        out = jnp.swapaxes(out, 1, 2).reshape(b, t, self.dim)
-        return nn.Dense(self.dim, use_bias=False)(out)
+        out = jnp.swapaxes(out, 1, 2).reshape(b, t, local_heads * head_dim)
+        out = nn.Dense(self.dim, use_bias=False)(out)
+        if self.tp_axis is not None:
+            # Row-parallel output projection: each shard contributed its
+            # heads' partial sum; one collective completes the projection
+            # (and types the activations invariant over the tp axis, which
+            # is what keeps replicated layers' gradients single-counted).
+            out = jax.lax.psum(out, self.tp_axis)
+        return out

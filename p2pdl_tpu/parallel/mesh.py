@@ -18,12 +18,22 @@ PEER_AXIS = "peers"
 # the device grid is (peers x seq); each peer's token sequence is sharded
 # over the seq axis and attention runs as ring attention over ICI.
 SEQ_AXIS = "seq"
+# Second mesh axis for tensor parallelism: with ``tp_shards > 1`` the grid
+# is (peers x tp); attention heads + MLP hidden shard over it (ops/tp.py).
+TP_AXIS = "tp"
 
 
-def make_mesh(n_devices: int | None = None, devices=None, seq_shards: int = 1) -> Mesh:
-    """A mesh named ``("peers",)`` — or ``("peers", "seq")`` when
-    ``seq_shards > 1``, splitting the ``n_devices`` grid so that
-    ``n_peer_devices = n_devices // seq_shards``."""
+def make_mesh(
+    n_devices: int | None = None,
+    devices=None,
+    seq_shards: int = 1,
+    tp_shards: int = 1,
+) -> Mesh:
+    """A mesh named ``("peers",)`` — or ``("peers", "seq")`` /
+    ``("peers", "tp")`` when sequence or tensor parallelism splits the
+    ``n_devices`` grid (``n_peer_devices = n_devices // shards``)."""
+    if seq_shards > 1 and tp_shards > 1:
+        raise ValueError("seq_shards and tp_shards are currently exclusive")
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
@@ -31,13 +41,16 @@ def make_mesh(n_devices: int | None = None, devices=None, seq_shards: int = 1) -
             raise ValueError(f"requested {n_devices} devices, have {len(devices)}")
         devices = devices[:n_devices]
     devices = np.asarray(devices)
-    if seq_shards <= 1:
+    shards, axis = max(seq_shards, 1), SEQ_AXIS
+    if tp_shards > 1:
+        shards, axis = tp_shards, TP_AXIS
+    if shards <= 1:
         return Mesh(devices, (PEER_AXIS,))
-    if devices.size % seq_shards != 0:
+    if devices.size % shards != 0:
         raise ValueError(
-            f"seq_shards ({seq_shards}) must divide the device count ({devices.size})"
+            f"{axis}_shards ({shards}) must divide the device count ({devices.size})"
         )
-    return Mesh(devices.reshape(-1, seq_shards), (PEER_AXIS, SEQ_AXIS))
+    return Mesh(devices.reshape(-1, shards), (PEER_AXIS, axis))
 
 
 def peer_sharding(mesh: Mesh) -> NamedSharding:

@@ -72,11 +72,12 @@ def make_optimizer(cfg: Config) -> optax.GradientTransformation:
     return optax.sgd(cfg.lr)
 
 
-def build_model(cfg: Config, seq_axis: str | None = None):
-    """Build the configured model. ``seq_axis`` names the mesh axis the
-    token sequence is sharded over (only inside ``shard_map``); the default
-    ``None`` is the dense twin — same param pytree, so init and eval share
-    one model while the compiled round runs the sequence-parallel one."""
+def build_model(cfg: Config, seq_axis: str | None = None, tp_axis: str | None = None):
+    """Build the configured model. ``seq_axis`` / ``tp_axis`` name the mesh
+    axes the token sequence / heads+MLP-hidden are sharded over (only inside
+    ``shard_map``); the default ``None`` is the dense twin — same logical
+    param pytree, so init and eval share one model while the compiled round
+    runs the parallel one."""
     kwargs: dict[str, Any] = {}
     if cfg.model == "char_lstm":
         from p2pdl_tpu.data.synthetic import SHAKESPEARE_VOCAB_SIZE
@@ -85,8 +86,12 @@ def build_model(cfg: Config, seq_axis: str | None = None):
     if cfg.model == "vit_tiny":
         kwargs["attn_impl"] = cfg.attn_impl
         kwargs["pool"] = cfg.vit_pool
+        kwargs["heads"] = cfg.vit_heads
         if seq_axis is not None:
             kwargs["seq_axis"] = seq_axis
+        if tp_axis is not None:
+            kwargs["tp_axis"] = tp_axis
+            kwargs["tp_shards"] = cfg.tp_shards
     return get_model(cfg.model, **kwargs)
 
 
@@ -120,12 +125,30 @@ def init_peer_state(cfg: Config, key: jax.Array | None = None) -> PeerState:
 
 
 def shard_state(state: PeerState, cfg: Config, mesh) -> PeerState:
-    """Place a ``PeerState`` on the mesh with the layout-correct shardings."""
+    """Place a ``PeerState`` on the mesh with the layout-correct shardings.
+
+    Under tensor parallelism the sync-layout params get PER-LEAF placements
+    (column/row kernels split over the tp axis, ``ops.tp.param_specs``) —
+    the leaves keep their full logical shapes; only bytes move."""
+    from jax.sharding import NamedSharding
+
     ps = peer_sharding(mesh)
     rs = replicated_sharding(mesh)
     layout = params_layout(cfg)
+    if cfg.tp_shards > 1 and layout == "sync":
+        from p2pdl_tpu.ops import tp
+
+        param_shardings = jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec),
+            tp.param_specs(state.params),
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+        )
+    else:
+        param_shardings = jax.tree.map(
+            lambda _: ps if layout == "peer" else rs, state.params
+        )
     shardings = PeerState(
-        params=jax.tree.map(lambda _: ps if layout == "peer" else rs, state.params),
+        params=param_shardings,
         opt_state=jax.tree.map(
             lambda l: ps if getattr(l, "ndim", 0) >= 1 else rs, state.opt_state
         ),

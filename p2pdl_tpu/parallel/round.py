@@ -51,23 +51,54 @@ from p2pdl_tpu.ops import aggregators, sharded_aggregators
 from p2pdl_tpu.ops.attacks import apply_attack
 from p2pdl_tpu.ops.gossip import ring_mix
 from p2pdl_tpu.ops.secure_agg import apply_masks
-from p2pdl_tpu.parallel.mesh import PEER_AXIS, SEQ_AXIS, peers_per_device
+from p2pdl_tpu.parallel.mesh import PEER_AXIS, SEQ_AXIS, TP_AXIS, peers_per_device
 from p2pdl_tpu.parallel.peer_state import (
     PeerState,
     build_model,
     global_params,
+    init_peer_state,
     make_optimizer,
     params_layout,
 )
 
 
-def make_forward_fn(model: Any, compute_dtype: jnp.dtype) -> Callable:
+def _mesh_axes_for(cfg: Config, mesh: Mesh) -> tuple[str | None, str | None]:
+    """(seq_axis, tp_axis) for this config, validated against the mesh."""
+    seq_axis = SEQ_AXIS if cfg.seq_shards > 1 else None
+    tp_axis = TP_AXIS if cfg.tp_shards > 1 else None
+    for axis, knob in ((seq_axis, "seq_shards"), (tp_axis, "tp_shards")):
+        if axis is not None and axis not in mesh.shape:
+            raise ValueError(
+                f"cfg.{knob}={getattr(cfg, knob)} needs a (peers x {axis}) "
+                f"mesh; build it with make_mesh({knob}=...)"
+            )
+    return seq_axis, tp_axis
+
+
+def _tp_params_spec(cfg: Config):
+    """Per-leaf PartitionSpec tree for tensor-parallel params (full logical
+    shapes, column/row kernels split over the tp axis — ``ops.tp``)."""
+    from p2pdl_tpu.ops import tp
+
+    abstract = jax.eval_shape(lambda: init_peer_state(cfg)).params
+    return tp.param_specs(abstract)
+
+
+def make_forward_fn(
+    model: Any, compute_dtype: jnp.dtype, param_transform: Callable | None = None
+) -> Callable:
     """``(params, x) -> float32 logits`` with the mixed-precision policy:
     params/float inputs cast to the compute dtype (bfloat16 by default) so
     matmuls hit the MXU, logits returned in float32. Shared by training and
-    eval so their numerics cannot diverge."""
+    eval so their numerics cannot diverge. ``param_transform`` applies a
+    pure view transform before the forward (tensor parallelism pre-scales
+    row-parallel biases by 1/tp — ``ops.tp``); gradients flow through it,
+    which is exactly what makes the stored (untransformed) params' update
+    come out dense-equivalent."""
 
     def forward(params, x):
+        if param_transform is not None:
+            params = param_transform(params)
         cparams = jax.tree.map(lambda p: p.astype(compute_dtype), params)
         if jnp.issubdtype(x.dtype, jnp.floating):
             x = x.astype(compute_dtype)
@@ -76,17 +107,29 @@ def make_forward_fn(model: Any, compute_dtype: jnp.dtype) -> Callable:
     return forward
 
 
-def make_loss_fn(model: Any, compute_dtype: jnp.dtype) -> Callable:
+def make_loss_fn(
+    model: Any, compute_dtype: jnp.dtype, param_transform: Callable | None = None
+) -> Callable:
     """Mean CE loss (reference wires ``CrossEntropyLoss`` at
     ``node/node.py:31``). Handles both ``[B, C]`` logits with ``[B]`` labels
     and sequence-model ``[B, T, C]`` logits with ``[B, T]`` targets."""
-    forward = make_forward_fn(model, compute_dtype)
+    forward = make_forward_fn(model, compute_dtype, param_transform)
 
     def loss_fn(params, x, y):
         logits = forward(params, x)
         return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
     return loss_fn
+
+
+def _param_transform(cfg: Config) -> Callable | None:
+    """The TP bias-view transform when tensor parallelism is on."""
+    if cfg.tp_shards <= 1:
+        return None
+    from p2pdl_tpu.ops import tp
+
+    factor = 1.0 / cfg.tp_shards
+    return lambda p: tp.scale_row_parallel_biases(p, factor)
 
 
 def make_local_train(
@@ -107,7 +150,7 @@ def make_local_train(
     the pooling ``pmean`` are not double-counted. (``seq_axis`` is accepted
     for signature symmetry; the psum is implicit.)"""
     del seq_axis  # implicit via vma typing; see docstring
-    loss_fn = make_loss_fn(model, jnp.dtype(cfg.compute_dtype))
+    loss_fn = make_loss_fn(model, jnp.dtype(cfg.compute_dtype), _param_transform(cfg))
     if cfg.remat:
         loss_fn = jax.checkpoint(loss_fn)
     grad_fn = jax.value_and_grad(loss_fn)
@@ -189,6 +232,7 @@ def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
         and not cfg.brb_enabled
         and not cfg.remat
         and cfg.seq_shards == 1
+        and cfg.tp_shards == 1
         and cfg.momentum == 0.0
         and cfg.local_epochs == 1
         and cfg.batches_per_epoch == 1
@@ -219,13 +263,8 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
     The input ``state`` is donated: the round overwrites it in place, so the
     caller must use the returned state (all call sites thread it through).
     """
-    seq_axis = SEQ_AXIS if cfg.seq_shards > 1 else None
-    if seq_axis is not None and SEQ_AXIS not in mesh.shape:
-        raise ValueError(
-            f"cfg.seq_shards={cfg.seq_shards} needs a (peers x seq) mesh; "
-            f"build it with make_mesh(seq_shards=...)"
-        )
-    model = build_model(cfg, seq_axis=seq_axis)
+    seq_axis, tp_axis = _mesh_axes_for(cfg, mesh)
+    model = build_model(cfg, seq_axis=seq_axis, tp_axis=tp_axis)
     opt = make_optimizer(cfg)
     l_per_dev = peers_per_device(cfg.num_peers, mesh)
     emit_delta = False
@@ -239,6 +278,9 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
     else:
         body = _general_sync_body(cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis)
         params_spec = P()
+    if tp_axis is not None:
+        # Per-leaf placement: column/row kernels split over the tp axis.
+        params_spec = _tp_params_spec(cfg)
 
     sp = P(PEER_AXIS)
     sr = P()
@@ -302,13 +344,8 @@ def build_multi_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Calla
     """
     if cfg.brb_enabled:
         raise ValueError("fused rounds cannot host the BRB trust plane between phases")
-    seq_axis = SEQ_AXIS if cfg.seq_shards > 1 else None
-    if seq_axis is not None and SEQ_AXIS not in mesh.shape:
-        raise ValueError(
-            f"cfg.seq_shards={cfg.seq_shards} needs a (peers x seq) mesh; "
-            f"build it with make_mesh(seq_shards=...)"
-        )
-    model = build_model(cfg, seq_axis=seq_axis)
+    seq_axis, tp_axis = _mesh_axes_for(cfg, mesh)
+    model = build_model(cfg, seq_axis=seq_axis, tp_axis=tp_axis)
     opt = make_optimizer(cfg)
     l_per_dev = peers_per_device(cfg.num_peers, mesh)
     if params_layout(cfg) == "peer":
@@ -320,6 +357,8 @@ def build_multi_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Calla
     else:
         body = _general_sync_body(cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis)
         params_spec = P()
+    if tp_axis is not None:
+        params_spec = _tp_params_spec(cfg)
 
     def multi_body(params, opt_state, rng, x, y, trainer_mat, byz_gate, round0, base_key):
         def step(carry, inputs):

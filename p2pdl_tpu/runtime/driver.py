@@ -225,7 +225,9 @@ class Experiment:
         # starts with a clean slate, like any real failure detector).
         self.failure_cooldown_rounds = failure_cooldown_rounds
         self._suspect_until: dict[int, int] = {}
-        self.mesh = make_mesh(n_devices, seq_shards=cfg.seq_shards)
+        self.mesh = make_mesh(
+            n_devices, seq_shards=cfg.seq_shards, tp_shards=cfg.tp_shards
+        )
         self.data = make_federated_data(cfg)
         # Sync layouts with the trust plane on use the split (two-program)
         # round so the BRB verdict gates the aggregate between the phases;

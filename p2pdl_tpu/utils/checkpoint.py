@@ -129,18 +129,28 @@ class Checkpointer:
             step, args=ocp.args.Composite(config=ocp.args.JsonRestore())
         )["config"]
         saved_version = meta.get("format_version", 1)
-        # v1 -> v2 changed only the sync-layout params (peer-stacked -> one
-        # global copy); the peer layout (gossip) is byte-identical across
-        # versions, so its v1 checkpoints stay restorable.
-        if saved_version == 1 and FORMAT_VERSION == 2 and params_layout(cfg) == "peer":
+        # Version shims — each format bump changed a specific slice of the
+        # state, so checkpoints untouched by that slice stay restorable:
+        # v1 -> v2: sync-layout params went peer-stacked -> one global copy
+        #   (the peer/gossip layout is byte-identical);
+        # v2 -> v3: the ViT qkv kernel's column order went qkv-major ->
+        #   head-major (tensor parallelism needs contiguous per-head slices)
+        #   — models without attention are byte-identical.
+        if saved_version == 2 and cfg.model != "vit_tiny":
+            saved_version = FORMAT_VERSION
+        elif (
+            saved_version == 1
+            and params_layout(cfg) == "peer"
+            and cfg.model != "vit_tiny"
+        ):
             saved_version = FORMAT_VERSION
         if saved_version != FORMAT_VERSION:
             raise ValueError(
                 f"checkpoint at {self.directory} step {step} has state-layout "
                 f"format v{saved_version}, this build reads v{FORMAT_VERSION} "
-                f"(v2 stores sync-aggregator params as one global copy, not "
-                f"peer-stacked); re-run the experiment to produce a new "
-                f"checkpoint"
+                f"(v2: sync params stored as one global copy; v3: ViT qkv "
+                f"kernels in head-major column order); re-run the experiment "
+                f"to produce a new checkpoint"
             )
         saved_cfg = Config(**meta["config"])
         diff = _config_diff(saved_cfg, cfg)
@@ -181,10 +191,11 @@ RESUME_COMPATIBLE_FIELDS = (
     "secure_agg_neighbors",
 )
 
-# Bumped when the PeerState pytree layout changes (v2: sync-layout params are
-# a single global copy). An identical Config can describe either layout, so
-# the config diff alone cannot catch a stale checkpoint — the version can.
-FORMAT_VERSION = 2
+# Bumped when the PeerState pytree layout changes (v2: sync-layout params
+# are a single global copy; v3: ViT qkv kernels in head-major column order
+# for tensor parallelism). An identical Config can describe either layout,
+# so the config diff alone cannot catch a stale checkpoint — the version can.
+FORMAT_VERSION = 3
 
 
 def _config_diff(a: Config, b: Config) -> dict[str, tuple[Any, Any]]:

@@ -145,6 +145,30 @@ def test_v1_gossip_checkpoint_restorable(tmp_path, monkeypatch):
         ck2.restore(TINY)
 
 
+def test_v2_checkpoint_vit_rejected_others_accepted(tmp_path, monkeypatch):
+    """v2 -> v3 changed only the ViT qkv column order: v2 checkpoints of
+    non-attention models stay restorable; v2 ViT checkpoints are rejected
+    (their qkv kernels would be silently reinterpreted head-major)."""
+    from p2pdl_tpu.utils import checkpoint as ckpt_mod
+
+    state = init_peer_state(TINY)
+    ck = Checkpointer(str(tmp_path / "mlp"))
+    with monkeypatch.context() as m:
+        m.setattr(ckpt_mod, "FORMAT_VERSION", 2)
+        ck.save(state, TINY)
+    restored = ck.restore(TINY)
+    assert _trees_equal(state.params, restored.params)
+
+    vit = TINY.replace(model="vit_tiny", dataset="cifar10")
+    vit_state = init_peer_state(vit)
+    ck2 = Checkpointer(str(tmp_path / "vit"))
+    with monkeypatch.context() as m:
+        m.setattr(ckpt_mod, "FORMAT_VERSION", 2)
+        ck2.save(vit_state, vit)
+    with pytest.raises(ValueError, match="format"):
+        ck2.restore(vit)
+
+
 def test_missing_checkpoint_raises(tmp_path):
     ck = Checkpointer(str(tmp_path / "empty"))
     assert ck.latest_step() is None
