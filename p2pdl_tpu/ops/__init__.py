@@ -1,4 +1,6 @@
-"""On-device collective ops: aggregation reducers, gossip, secure masking."""
+"""On-device collective ops: aggregation reducers (dense + blockwise-
+streamed), gossip, secure masking, attention (dense / fused Pallas / ring),
+tensor-parallel placement."""
 
 from p2pdl_tpu.ops.aggregators import (
     fedavg,
@@ -9,6 +11,13 @@ from p2pdl_tpu.ops.aggregators import (
     pairwise_sq_dists,
     trimmed_mean,
 )
+from p2pdl_tpu.ops.sharded_aggregators import (
+    block_gram,
+    krum_sharded,
+    median_sharded,
+    multi_krum_sharded,
+    trimmed_mean_sharded,
+)
 
 __all__ = [
     "fedavg",
@@ -18,4 +27,9 @@ __all__ = [
     "multi_krum",
     "pairwise_sq_dists",
     "trimmed_mean",
+    "block_gram",
+    "krum_sharded",
+    "median_sharded",
+    "multi_krum_sharded",
+    "trimmed_mean_sharded",
 ]
