@@ -63,6 +63,47 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _device_healthy(timeout_s: float = 180.0, attempts: int = 3, sleep_s: float = 60.0) -> bool:
+    """Probe the backend with a tiny matmul IN A SUBPROCESS before committing
+    to timed runs. A wedged TPU tunnel (observed after any process dies
+    mid-TPU-work) makes device calls HANG rather than error — no in-process
+    retry survives that, but a killable probe subprocess does."""
+    import subprocess
+
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "jnp.sum(jnp.ones((128,128)) @ jnp.ones((128,128))).block_until_ready();"
+        "print('bench-probe-ok')"
+    )
+    for i in range(1, attempts + 1):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                timeout=timeout_s,
+                text=True,
+            )
+            if "bench-probe-ok" in r.stdout:
+                return True
+            _log(f"[bench] health probe {i}/{attempts} failed: {r.stderr[-200:]}")
+        except subprocess.TimeoutExpired:
+            _log(f"[bench] health probe {i}/{attempts} hung >{timeout_s}s (wedged tunnel?)")
+        if i < attempts:
+            time.sleep(sleep_s)
+    return False
+
+
+def _unavailable_record() -> dict:
+    return {
+        "metric": "agg_rounds_per_sec_1024peers_mlp",
+        "value": 0.0,
+        "unit": "rounds/sec",
+        "vs_baseline": 0.0,
+        "error": "device backend unavailable or hung (health probe failed); "
+        "see stderr for probe attempts",
+    }
+
+
 def _with_retry(fn, name: str, attempts: int = 3, backoff_s: float = 15.0):
     """Run ``fn`` with backoff; returns (value, error_record_or_None)."""
     last = None
@@ -437,6 +478,11 @@ def run_time_to_acc(target: float = 0.70, max_rounds: int = 200) -> dict:
 
 
 def main() -> None:
+    if not _device_healthy():
+        # Deterministic failure beats an indefinite hang: emit the
+        # structured record on stdout (the driver contract) and exit clean.
+        print(json.dumps(_unavailable_record()))
+        return
     if "--time-to-acc" in sys.argv:
         i = sys.argv.index("--time-to-acc")
         target = 0.70
