@@ -87,11 +87,58 @@ def build_parser() -> argparse.ArgumentParser:
         "on power-of-two meshes)",
     )
     p.add_argument(
+        "--vit-depth",
+        type=int,
+        default=12,
+        help="ViT trunk depth (12 = standard ViT-Tiny)",
+    )
+    p.add_argument(
         "--tp-shards",
         type=int,
         default=1,
         help="tensor parallelism: shard attention heads + MLP hidden over "
         "a mesh axis of this size (megatron column/row); 1=off",
+    )
+    p.add_argument(
+        "--moe-experts",
+        type=int,
+        default=0,
+        help="mixture-of-experts: swap every --moe-every-th ViT block's MLP "
+        "for a top-1 mixture of this many experts; 0=dense MLPs",
+    )
+    p.add_argument("--moe-every", type=int, default=2)
+    p.add_argument(
+        "--moe-capacity-factor",
+        type=float,
+        default=2.0,
+        help="per-expert slots = factor * tokens / experts (tokens past "
+        "capacity drop; >= experts makes dropping impossible)",
+    )
+    p.add_argument(
+        "--ep-shards",
+        type=int,
+        default=1,
+        help="expert parallelism: shard the MoE experts over a mesh axis of "
+        "this size (tokens routed by all_to_all); 1=off",
+    )
+    p.add_argument(
+        "--pp-shards",
+        type=int,
+        default=1,
+        help="pipeline parallelism: shard the ViT trunk depth over a mesh "
+        "axis of this size (microbatch ppermute schedule); 1=off",
+    )
+    p.add_argument(
+        "--pp-microbatches",
+        type=int,
+        default=0,
+        help="microbatches per batch for the pipeline schedule; 0=pp-shards",
+    )
+    p.add_argument(
+        "--vit-scan-blocks",
+        action="store_true",
+        help="store the ViT trunk as one nn.scan stack (faster compile; "
+        "the pytree-identical dense twin of a --pp-shards run)",
     )
     p.add_argument("--attack", default="none", help="Byzantine attack for injected peers")
     p.add_argument("--byz-ids", default="", help="comma-separated adversarial peer ids")
@@ -158,7 +205,15 @@ def config_from_args(args: argparse.Namespace) -> Config:
         seq_shards=args.seq_shards,
         vit_pool=args.vit_pool,
         vit_heads=args.vit_heads,
+        vit_depth=args.vit_depth,
         tp_shards=args.tp_shards,
+        moe_experts=args.moe_experts,
+        moe_every=args.moe_every,
+        moe_capacity_factor=args.moe_capacity_factor,
+        ep_shards=args.ep_shards,
+        pp_shards=args.pp_shards,
+        pp_microbatches=args.pp_microbatches,
+        vit_scan_blocks=args.vit_scan_blocks,
     )
 
 

@@ -107,10 +107,40 @@ class Config:
     # ViT attention head count (3 = standard ViT-Tiny; 4 divides evenly for
     # tensor parallelism on power-of-two meshes).
     vit_heads: int = 3
+    # ViT trunk depth (12 = standard ViT-Tiny; smaller depths compile
+    # proportionally faster — useful for dryruns and tests).
+    vit_depth: int = 12
     # Tensor parallelism: shard attention heads + MLP hidden over a mesh
     # axis of this size (megatron column/row decomposition, ops/tp.py).
     # 1 = off. Requires vit_tiny, tp_shards | vit_heads, plain SGD.
     tp_shards: int = 1
+    # Mixture-of-experts: replace the MLP of every ``moe_every``-th ViT
+    # block with a top-1 (Switch) mixture of ``moe_experts`` experts
+    # (ops/moe.py). 0 = dense MLP everywhere.
+    moe_experts: int = 0
+    moe_every: int = 2
+    # Per-expert buffer slots = capacity_factor * tokens / experts; tokens
+    # past capacity are dropped (residual carries them). >= moe_experts
+    # makes dropping impossible.
+    moe_capacity_factor: float = 2.0
+    # Expert parallelism: shard the experts over a mesh axis of this size;
+    # each peer's batch splits over the same axis and tokens reach their
+    # expert's owner by all_to_all. 1 = off. Requires moe_experts > 0,
+    # ep_shards | moe_experts, ep_shards | batch_size, plain SGD.
+    ep_shards: int = 1
+    # Pipeline parallelism: shard the ViT trunk's depth over a mesh axis of
+    # this size (nn.scan-stacked blocks, microbatch ppermute schedule —
+    # ops/pipeline.py). 1 = off. Requires vit_tiny, pp_shards | depth,
+    # plain SGD.
+    pp_shards: int = 1
+    # Microbatches per batch for the pipeline schedule; 0 = pp_shards.
+    pp_microbatches: int = 0
+    # Store the ViT trunk as ONE nn.scan stack (param leaves lead with a
+    # depth dim) even without pipeline parallelism: the single-copy trunk
+    # compiles faster (XLA traces one block, not `depth`) and is the
+    # pytree-identical dense twin of a pp_shards > 1 run. Implied by
+    # pp_shards > 1.
+    vit_scan_blocks: bool = False
 
     def __post_init__(self) -> None:
         if self.num_peers < 2:
@@ -149,42 +179,12 @@ class Config:
                     f"vit_heads must divide the ViT-Tiny width {ViTTiny.dim}, "
                     f"got {self.vit_heads}"
                 )
+            if self.vit_depth < 1:
+                raise ValueError(f"vit_depth must be >= 1, got {self.vit_depth}")
         if self.tp_shards < 1:
             raise ValueError(f"tp_shards must be >= 1, got {self.tp_shards}")
         if self.tp_shards > 1:
-            if self.model != "vit_tiny":
-                raise ValueError(
-                    f"tp_shards > 1 requires a transformer (vit_tiny); "
-                    f"model={self.model!r}"
-                )
-            if self.seq_shards > 1:
-                raise ValueError(
-                    "tp_shards and seq_shards are currently exclusive "
-                    "(one extra mesh axis at a time)"
-                )
-            if self.momentum != 0.0:
-                raise ValueError(
-                    "tp_shards > 1 requires momentum=0.0 (optimizer state "
-                    "sharding over the tp axis is not yet implemented)"
-                )
-            if self.brb_enabled:
-                raise ValueError(
-                    "tp_shards > 1 with the BRB trust plane is not yet "
-                    "supported (the split-round path assumes replicated "
-                    "params)"
-                )
-            if self.aggregator == "gossip":
-                raise ValueError("tp_shards > 1 is not supported with gossip")
-            if self.aggregator in ("krum", "multi_krum"):
-                # Krum's pairwise distances need the FULL update; per-tp-shard
-                # slices would score (and possibly select) different trainers
-                # per shard. Coordinate-wise reducers (trimmed_mean/median)
-                # act per-coordinate and stay correct per slice.
-                raise ValueError(
-                    "tp_shards > 1 is not supported with distance-based "
-                    "robust reducers (krum/multi_krum); use trimmed_mean, "
-                    "median, or the fedavg family"
-                )
+            self._validate_model_parallel_knob("tp_shards")
             from p2pdl_tpu.models.vit import TransformerBlock, ViTTiny
             from p2pdl_tpu.ops.tp import validate_tp_geometry
 
@@ -194,6 +194,83 @@ class Config:
                 ViTTiny.dim * TransformerBlock.mlp_ratio,
                 self.tp_shards,
             )
+        if self.moe_experts < 0:
+            raise ValueError(f"moe_experts must be >= 0, got {self.moe_experts}")
+        if self.moe_every < 1:
+            raise ValueError(f"moe_every must be >= 1, got {self.moe_every}")
+        if self.moe_capacity_factor <= 0:
+            raise ValueError(
+                f"moe_capacity_factor must be > 0, got {self.moe_capacity_factor}"
+            )
+        if self.moe_experts > 0 and self.model != "vit_tiny":
+            raise ValueError(
+                f"moe_experts > 0 requires a transformer (vit_tiny); "
+                f"model={self.model!r}"
+            )
+        if self.moe_experts > 0:
+            if self.moe_every > self.vit_depth:
+                # Silently-dense MoE: no block index satisfies
+                # i % moe_every == moe_every - 1, so the "MoE" model would
+                # have zero expert blocks.
+                raise ValueError(
+                    f"moe_every ({self.moe_every}) must be <= the ViT depth "
+                    f"({self.vit_depth}); larger values select no MoE block"
+                )
+        if self.moe_experts > 0 and self.tp_shards > 1:
+            raise ValueError(
+                "moe_experts > 0 with tp_shards > 1 is not yet supported "
+                "(tensor-parallel param placement does not cover the "
+                "expert-stacked leaves)"
+            )
+        if self.ep_shards < 1:
+            raise ValueError(f"ep_shards must be >= 1, got {self.ep_shards}")
+        if self.ep_shards > 1:
+            if self.moe_experts <= 0:
+                raise ValueError(
+                    "ep_shards > 1 requires moe_experts > 0 (expert "
+                    "parallelism shards the MoE experts)"
+                )
+            self._validate_model_parallel_knob("ep_shards")
+            from p2pdl_tpu.ops.moe import validate_ep_geometry
+
+            validate_ep_geometry(self.moe_experts, self.ep_shards, self.batch_size)
+        if self.pp_shards < 1:
+            raise ValueError(f"pp_shards must be >= 1, got {self.pp_shards}")
+        if self.pp_microbatches < 0:
+            raise ValueError(
+                f"pp_microbatches must be >= 0, got {self.pp_microbatches}"
+            )
+        if self.pp_shards > 1:
+            self._validate_model_parallel_knob("pp_shards")
+            if self.moe_experts > 0:
+                raise ValueError(
+                    "pp_shards > 1 with moe_experts > 0 is not yet supported "
+                    "(the scan-blocks stack assumes homogeneous blocks)"
+                )
+            from p2pdl_tpu.ops.pipeline import validate_pp_geometry
+
+            validate_pp_geometry(
+                self.vit_depth,
+                self.pp_shards,
+                self.batch_size,
+                self.effective_pp_microbatches,
+            )
+        if self.uses_scan_blocks:
+            if self.model != "vit_tiny":
+                raise ValueError(
+                    f"vit_scan_blocks requires model='vit_tiny'; "
+                    f"model={self.model!r}"
+                )
+            if self.moe_experts > 0 or self.tp_shards > 1 or self.seq_shards > 1:
+                raise ValueError(
+                    "the scan-blocks trunk does not compose with MoE / "
+                    "tensor / sequence parallelism yet"
+                )
+            if self.batch_size % self.effective_pp_microbatches != 0:
+                raise ValueError(
+                    f"pp_microbatches ({self.effective_pp_microbatches}) "
+                    f"must divide batch_size ({self.batch_size})"
+                )
         if self.seq_shards < 1:
             raise ValueError(f"seq_shards must be >= 1, got {self.seq_shards}")
         if self.seq_shards > 1:
@@ -253,9 +330,60 @@ class Config:
                     f"{2 * self.byzantine_f + 3}, got {self.trainers_per_round}"
                 )
 
+    def _validate_model_parallel_knob(self, knob: str) -> None:
+        """Shared restriction set for the tp/ep/pp second-mesh-axis knobs.
+
+        One place, not three: the next lifted restriction (momentum, BRB,
+        a new axis) changes here only."""
+        if self.model != "vit_tiny":
+            raise ValueError(
+                f"{knob} > 1 requires a transformer (vit_tiny); "
+                f"model={self.model!r}"
+            )
+        active = [
+            k
+            for k in ("seq_shards", "tp_shards", "ep_shards", "pp_shards")
+            if getattr(self, k) > 1
+        ]
+        if len(active) > 1:
+            raise ValueError(
+                f"model-parallel mesh axes are currently exclusive (one "
+                f"second mesh axis at a time); requested {', '.join(active)}"
+            )
+        if self.momentum != 0.0:
+            raise ValueError(
+                f"{knob} > 1 requires momentum=0.0 (optimizer state "
+                f"sharding over the second mesh axis is not yet implemented)"
+            )
+        if self.brb_enabled:
+            raise ValueError(
+                f"{knob} > 1 with the BRB trust plane is not yet supported "
+                f"(the split-round digest path assumes a 1-D peer mesh)"
+            )
+        if self.aggregator == "gossip":
+            raise ValueError(f"{knob} > 1 is not supported with gossip")
+        if self.aggregator in ("krum", "multi_krum"):
+            # Distance-based reducers score FULL updates; per-shard slices
+            # would score (and possibly select) different trainers per
+            # shard. Coordinate-wise reducers (trimmed_mean/median) act
+            # per-coordinate and stay correct per slice.
+            raise ValueError(
+                f"{knob} > 1 is not supported with distance-based robust "
+                f"reducers (krum/multi_krum); use trimmed_mean, median, or "
+                f"the fedavg family"
+            )
+
     @property
     def testers_per_round(self) -> int:
         return self.num_peers - self.trainers_per_round
+
+    @property
+    def effective_pp_microbatches(self) -> int:
+        return self.pp_microbatches if self.pp_microbatches > 0 else self.pp_shards
+
+    @property
+    def uses_scan_blocks(self) -> bool:
+        return self.vit_scan_blocks or self.pp_shards > 1
 
     @property
     def batches_per_epoch(self) -> int:

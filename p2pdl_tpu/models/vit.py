@@ -38,6 +38,13 @@ class TransformerBlock(nn.Module):
     # tp_shards sizes the declared features to the local slice.
     tp_axis: str | None = None
     tp_shards: int = 1
+    # Mixture-of-experts: > 0 replaces this block's MLP with a top-1
+    # mixture of that many experts (ops/moe.py); under expert parallelism
+    # the experts shard over ``ep_axis``.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 2.0
+    ep_axis: str | None = None
+    ep_shards: int = 1
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -53,6 +60,18 @@ class TransformerBlock(nn.Module):
             tp_shards=self.tp_shards,
         )(y)
         y = nn.LayerNorm()(x)
+        if self.moe_experts > 0:
+            from p2pdl_tpu.ops.moe import MoEFFN
+
+            y = MoEFFN(
+                num_experts=self.moe_experts,
+                dim=self.dim,
+                hidden=self.dim * self.mlp_ratio,
+                capacity_factor=self.moe_capacity_factor,
+                ep_axis=self.ep_axis,
+                ep_shards=self.ep_shards,
+            )(y)
+            return x + y
         # Column-parallel fc1 under tp (declared width = local slice).
         y = nn.Dense(self.dim * self.mlp_ratio // self.tp_shards)(y)
         y = nn.gelu(y)
@@ -75,6 +94,24 @@ class ViTTiny(nn.Module):
     seq_axis: str | None = None  # mesh axis the token sequence is sharded on
     tp_axis: str | None = None  # mesh axis heads/MLP-hidden are sharded on
     tp_shards: int = 1
+    # Mixture-of-experts: every ``moe_every``-th block (1-based from block
+    # moe_every - 1) swaps its MLP for a top-1 mixture of ``moe_experts``
+    # experts; ``ep_axis`` shards the experts (expert parallelism).
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 2.0
+    ep_axis: str | None = None
+    ep_shards: int = 1
+    # Pipeline parallelism: ``scan_blocks`` stores the trunk as ONE nn.scan
+    # stack (param leaves lead with a depth dim); ``pp_axis`` shards that
+    # dim — each shard runs depth/pp_shards blocks and microbatch
+    # activations rotate by ppermute (ops/pipeline.py). The scan-blocks
+    # param tree differs from the unstacked default (depth-stacked leaves),
+    # so the dense twin of a pp run must also set scan_blocks.
+    scan_blocks: bool = False
+    pp_axis: str | None = None
+    pp_shards: int = 1
+    pp_microbatches: int = 1
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -114,15 +151,42 @@ class ViTTiny(nn.Module):
             pos = lax.dynamic_slice(pos, (0, start, 0), (1, t_local, self.dim))
         x = x + pos
 
-        for _ in range(self.depth):
-            x = TransformerBlock(
-                self.dim,
-                self.heads,
-                attn_impl=self.attn_impl,
-                seq_axis=self.seq_axis,
-                tp_axis=self.tp_axis,
-                tp_shards=self.tp_shards,
+        if self.scan_blocks:
+            if self.moe_experts > 0 or self.tp_axis is not None or self.seq_axis is not None:
+                raise ValueError(
+                    "scan_blocks (pipeline parallelism) does not compose "
+                    "with MoE / tensor / sequence parallelism yet"
+                )
+            from p2pdl_tpu.ops.pipeline import PipelinedBlocks
+
+            x = PipelinedBlocks(
+                make_block=TransformerBlock,
+                block_kwargs=(
+                    ("dim", self.dim),
+                    ("heads", self.heads),
+                    ("attn_impl", self.attn_impl),
+                ),
+                local_depth=self.depth // self.pp_shards,
+                microbatches=self.pp_microbatches,
+                pp_axis=self.pp_axis,
             )(x)
+        else:
+            for i in range(self.depth):
+                is_moe = (
+                    self.moe_experts > 0 and i % self.moe_every == self.moe_every - 1
+                )
+                x = TransformerBlock(
+                    self.dim,
+                    self.heads,
+                    attn_impl=self.attn_impl,
+                    seq_axis=self.seq_axis,
+                    tp_axis=self.tp_axis,
+                    tp_shards=self.tp_shards,
+                    moe_experts=self.moe_experts if is_moe else 0,
+                    moe_capacity_factor=self.moe_capacity_factor,
+                    ep_axis=self.ep_axis if is_moe else None,
+                    ep_shards=self.ep_shards if is_moe else 1,
+                )(x)
         x = nn.LayerNorm()(x)
         if self.pool == "cls":
             pooled = x[:, 0]

@@ -1,7 +1,8 @@
 """On-device collective ops: aggregation reducers (dense + blockwise-
 streamed), gossip, secure masking, attention (dense / fused Pallas / ring),
-tensor-parallel placement."""
+tensor-parallel placement, mixture-of-experts dispatch."""
 
+from p2pdl_tpu.ops.moe import MoEFFN, top1_route
 from p2pdl_tpu.ops.aggregators import (
     fedavg,
     krum,
@@ -32,4 +33,6 @@ __all__ = [
     "median_sharded",
     "multi_krum_sharded",
     "trimmed_mean_sharded",
+    "MoEFFN",
+    "top1_route",
 ]

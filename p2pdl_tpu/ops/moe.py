@@ -1,0 +1,192 @@
+"""Expert parallelism: mixture-of-experts FFN with all_to_all dispatch.
+
+Beyond the reference entirely (its zoo is MLP+CNN, reference
+``models/model.py:3-33``); this completes the parallelism-strategy inventory
+(dp / sp / tp / pp / ep) the framework exposes. The design is the GShard /
+Switch top-1 formulation (Lepikhin et al. 2020; Fedus et al. 2021) expressed
+the shard_map way:
+
+- the router (gate) is a replicated ``[D, E]`` projection over ALL experts;
+- expert weights are stacked on a leading expert dim — ``wi [E, D, H]``,
+  ``wo [E, H, D]`` — and sharded over the ``ep`` mesh axis on that dim, so
+  each shard owns ``E / ep_shards`` complete experts;
+- each shard routes its LOCAL token block (the per-peer batch is split over
+  the ep axis) into per-expert capacity buffers by scatter-add on flat slot
+  ids (NOT the GShard ``[n, E, C]`` dispatch one-hot, which is
+  memory-quadratic in token count — see :func:`top1_route`),
+  ``lax.all_to_all`` moves buffers to the experts' owners, the owners run
+  their experts as one stacked einsum (MXU-friendly: ``[E_local, S, D] x
+  [E_local, D, H]``), and a reverse ``all_to_all`` brings results home;
+- a slot gather scatters expert outputs back to token positions, scaled by
+  the gate probability.
+
+Two ``all_to_all``s per MoE layer — the textbook count. Tokens beyond an
+expert's capacity are dropped (their FFN output is zero; the residual
+carries them), exactly as in Switch; with ``capacity_factor >= num_experts``
+no token can ever drop and the ep-sharded layer equals its dense twin
+bit-for-bit modulo reduction order (test-asserted in
+``tests/test_expert_parallel.py``).
+
+Gradient story (why no explicit collectives appear in the backward): expert
+weights are ep-VARYING, so their grads are complete per shard — every remote
+token's contribution arrives through the ``all_to_all`` transpose (which is
+the reverse ``all_to_all``). The gate and all non-MoE params stay
+ep-INVARIANT; the local loss is pre-scaled by ``1 / ep_shards`` so the vma
+machinery's implicit psum over the ep axis reconstructs exactly the
+global-batch mean gradient (see ``parallel/round.py::make_local_train``).
+"""
+
+from __future__ import annotations
+
+import re
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from p2pdl_tpu.parallel.mesh import EP_AXIS
+
+
+def moe_capacity(tokens: int, num_experts: int, capacity_factor: float) -> int:
+    """Per-expert slot count for ``tokens`` routed tokens on one shard."""
+    return max(1, int(-(-capacity_factor * tokens // num_experts)))
+
+
+def top1_route(
+    gate_logits: jnp.ndarray, capacity: int
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Switch top-1 routing. ``gate_logits``: [n, E] (float32).
+
+    Returns ``(expert, slot, keep, prob)``, each ``[n]``: the token's
+    expert, its 0-based slot in that expert's capacity buffer, whether it
+    was admitted (slots fill in token order; tokens past ``capacity`` drop —
+    the residual carries them), and its gate probability. The compact form
+    deliberately avoids the GShard ``[n, E, C]`` dispatch one-hot: with
+    ``C ∝ n`` that tensor is memory-QUADRATIC in token count (a 1024-sample
+    ViT eval would need a ~35 GB dispatch tensor); scatter/gather by flat
+    slot id is O(n·D + E·C·D). With no drops the layer output is
+    slot-order invariant, which is what makes the ep layer equal its dense
+    twin even though their cumsum orders differ.
+    """
+    n, num_experts = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1).astype(jnp.int32)  # [n]
+    prob = jnp.max(probs, axis=-1)  # [n]
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)  # [n, E]
+    # 1-based arrival rank of each token within its expert.
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1)  # [n]
+    keep = pos <= capacity
+    slot = jnp.clip(pos - 1, 0, capacity - 1).astype(jnp.int32)
+    return expert, slot, keep, prob
+
+
+class MoEFFN(nn.Module):
+    """Top-1 mixture-of-experts FFN over ``[B, T, D]`` (or ``[n, D]``).
+
+    ``ep_axis = None`` is the dense twin: all ``num_experts`` experts live on
+    one shard (identical math, no collectives). With ``ep_axis`` set (inside
+    ``shard_map``), this module DECLARES the local expert slice
+    (``num_experts // ep_shards``) — flax validates param shapes at apply, so
+    the sharded twin must declare what the ``P(ep)`` placement hands it. The
+    logical (stored) pytree keeps the full ``[E, ...]`` shapes; see
+    :func:`param_specs`.
+    """
+
+    num_experts: int
+    dim: int
+    hidden: int
+    capacity_factor: float = 2.0
+    ep_axis: str | None = None
+    ep_shards: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if (self.ep_shards != 1) != (self.ep_axis is not None):
+            raise ValueError("ep_shards and ep_axis must be set together")
+        if self.num_experts % self.ep_shards != 0:
+            raise ValueError(
+                f"ep_shards ({self.ep_shards}) must divide num_experts "
+                f"({self.num_experts})"
+            )
+        e_local = self.num_experts // self.ep_shards
+        shape = x.shape
+        tokens = x.reshape(-1, shape[-1])  # [n, D]
+        n = tokens.shape[0]
+
+        gate_w = self.param(
+            "gate", nn.initializers.lecun_normal(), (self.dim, self.num_experts)
+        )
+        init = nn.initializers.lecun_normal(batch_axis=(0,))
+        wi = self.param("wi", init, (e_local, self.dim, self.hidden))
+        bi = self.param("bi", nn.initializers.zeros, (e_local, self.hidden))
+        wo = self.param("wo", init, (e_local, self.hidden, self.dim))
+        bo = self.param("bo", nn.initializers.zeros, (e_local, self.dim))
+
+        # Route in float32 (softmax/argmax stability under bfloat16 compute).
+        logits = (tokens.astype(jnp.float32)) @ (gate_w.astype(jnp.float32))
+        capacity = moe_capacity(n, self.num_experts, self.capacity_factor)
+        expert, slot, keep, prob = top1_route(logits, capacity)
+
+        # Scatter admitted tokens into per-expert capacity buffers by flat
+        # slot id; dropped tokens pile onto a dump row that is never read.
+        # Admitted (expert, slot) pairs are unique, so scatter-add has no
+        # real collisions (its transpose is the gather below).
+        flat = jnp.where(keep, expert * capacity + slot, self.num_experts * capacity)
+        buf = jnp.zeros((self.num_experts * capacity + 1, tokens.shape[-1]), x.dtype)
+        buf = buf.at[flat].add(tokens)
+        expert_in = buf[:-1].reshape(self.num_experts, capacity, -1)
+        if self.ep_axis is not None:
+            # Send each block of E_local consecutive experts to its owner;
+            # receive every shard's buffer for MY experts: [E, C, D] ->
+            # [E_local, ep * C, D] (slots from all source shards).
+            expert_in = lax.all_to_all(
+                expert_in, self.ep_axis, split_axis=0, concat_axis=1, tiled=True
+            )
+        h = jnp.einsum("esd,edh->esh", expert_in, wi.astype(x.dtype))
+        h = nn.gelu(h + bi.astype(x.dtype)[:, None])
+        out = jnp.einsum("esh,ehd->esd", h, wo.astype(x.dtype))
+        out = out + bo.astype(x.dtype)[:, None]
+        if self.ep_axis is not None:
+            # Reverse: give every source shard back its slots: [E_local,
+            # ep * C, D] -> [E, C, D].
+            out = lax.all_to_all(
+                out, self.ep_axis, split_axis=1, concat_axis=0, tiled=True
+            )
+        # Gather each token's slot output, scaled by its gate probability;
+        # dropped tokens read the zero dump row.
+        out_flat = jnp.concatenate(
+            [
+                out.reshape(self.num_experts * capacity, -1),
+                jnp.zeros((1, out.shape[-1]), out.dtype),
+            ]
+        )
+        y = out_flat[flat] * prob[:, None].astype(x.dtype)
+        return y.reshape(shape)
+
+
+# Leaf-path classification for expert-stacked params: wi/bi/wo/bo are
+# MoEFFN's expert-stacked leaves (no other module uses these names — flax
+# layers name theirs kernel/bias), whether MoEFFN is nested or the root.
+_EXPERT_LEAF = re.compile(r"(^|/)(wi|bi|wo|bo)$")
+
+
+def param_specs(params, ep_axis: str = EP_AXIS):
+    """Per-leaf ``PartitionSpec`` pytree: expert-stacked leaves split their
+    leading (expert) dim over the ep axis; everything else replicated
+    (shared walk: ``ops.placement.leading_dim_specs``)."""
+    from p2pdl_tpu.ops.placement import leading_dim_specs
+
+    return leading_dim_specs(params, _EXPERT_LEAF, ep_axis)
+
+
+def validate_ep_geometry(num_experts: int, ep_shards: int, batch_size: int) -> None:
+    if num_experts % ep_shards != 0:
+        raise ValueError(
+            f"ep_shards ({ep_shards}) must divide moe_experts ({num_experts})"
+        )
+    if batch_size % ep_shards != 0:
+        raise ValueError(
+            f"ep_shards ({ep_shards}) must divide batch_size ({batch_size}) — "
+            f"each ep shard trains on its slice of every batch"
+        )

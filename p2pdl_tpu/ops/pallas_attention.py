@@ -58,10 +58,14 @@ def _fwd_kernel(
 ):
     """Grid (bh, nq, nk), innermost sequential over key blocks.
 
-    Refs: q/o [1, bq, D]; k/v [1, bk, D]; lse [1, bq]; scratch o_acc [bq, D],
-    m/l_acc [bq, LANES] (row stats broadcast over lanes). ``off = Tk - Tq``
-    aligns causal positions for rectangular attention (sdpa's convention:
-    query i attends keys j <= i + off)."""
+    Refs: q/o [1, bq, D]; k/v [1, bk, D]; lse [1, bq, 1]; scratch o_acc
+    [bq, D], m/l_acc [bq, LANES] (row stats broadcast over lanes). The lse
+    trailing singleton exists for Mosaic's tiling rule: the last two dims of
+    a block must be (divisible by 8, divisible by 128) or equal to the array
+    dims — a 2-D [BH, T] layout would put the size-1 BH block in the
+    second-minor slot, which is neither. ``off = Tk - Tq`` aligns causal
+    positions for rectangular attention (sdpa's convention: query i attends
+    keys j <= i + off)."""
     iq, jk = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
     bq = q_ref.shape[1]
@@ -113,7 +117,7 @@ def _fwd_kernel(
         l = l_acc[:, 0]
         l_safe = jnp.maximum(l, 1e-30)
         o_ref[0] = (o_acc[:] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = jnp.where(jnp.isfinite(m), m + jnp.log(l_safe), NEG_INF)
+        lse_ref[0] = jnp.where(jnp.isfinite(m), m + jnp.log(l_safe), NEG_INF)[:, None]
 
 
 def _dkdv_kernel(
@@ -122,7 +126,7 @@ def _dkdv_kernel(
 ):
     """Grid (bh, nk, nq), innermost sequential over query blocks.
 
-    k/v/dk/dv [1, bk, D]; q/do [1, bq, D]; lse/delta [1, bq]; scratch
+    k/v/dk/dv [1, bk, D]; q/do [1, bq, D]; lse/delta [1, bq, 1]; scratch
     dk/dv_acc [bk, D] float32."""
     jk, iq = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
@@ -139,8 +143,8 @@ def _dkdv_kernel(
         v = v_ref[0].astype(jnp.float32)
         q_blk = q_ref[0].astype(jnp.float32)
         do_blk = do_ref[0].astype(jnp.float32)
-        lse_blk = lse_ref[0]
-        delta_blk = delta_ref[0]
+        lse_blk = lse_ref[0][:, 0]
+        delta_blk = delta_ref[0][:, 0]
 
         s = scale * jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -194,8 +198,8 @@ def _dq_kernel(
     def compute():
         q = q_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
         k_blk = k_ref[0].astype(jnp.float32)
         v_blk = v_ref[0].astype(jnp.float32)
         s = scale * jax.lax.dot_general(
@@ -284,11 +288,11 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq_pad, d), q.dtype, vma=_vma(q)),
-            jax.ShapeDtypeStruct((bh, tq_pad), jnp.float32, vma=_vma(q)),
+            jax.ShapeDtypeStruct((bh, tq_pad, 1), jnp.float32, vma=_vma(q)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -298,7 +302,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
         compiler_params=_SEMANTICS,
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :tq], lse[:, :tq]
+    return out[:, :tq], lse[:, :tq, 0]
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
@@ -332,8 +336,9 @@ def _flash_bwd_impl(causal, block_q, block_k, interpret, res, g, g_lse):
     pad_q = tq_pad - tq
     # Padded q rows: lse=-inf gives well-defined (finite) p rows, and their
     # do rows are zero, so they contribute nothing to dk/dv.
-    lse_p = jnp.pad(lse, ((0, 0), (0, pad_q)), constant_values=NEG_INF)
-    delta_p = jnp.pad(delta, ((0, 0), (0, pad_q)))
+    # Trailing singleton for the Mosaic block-tiling rule (see _fwd_kernel).
+    lse_p = jnp.pad(lse, ((0, 0), (0, pad_q)), constant_values=NEG_INF)[:, :, None]
+    delta_p = jnp.pad(delta, ((0, 0), (0, pad_q)))[:, :, None]
 
     dkdv = functools.partial(
         _dkdv_kernel, scale=scale, causal=causal, t_real=tk, off=off
@@ -346,8 +351,8 @@ def _flash_bwd_impl(causal, block_q, block_k, interpret, res, g, g_lse):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -374,8 +379,8 @@ def _flash_bwd_impl(causal, block_q, block_k, interpret, res, g, g_lse):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, tq_pad, d), q.dtype, vma=_vma(q)),

@@ -1,0 +1,35 @@
+"""Shared per-leaf parameter-placement helpers.
+
+The model-parallel strategies place FULL-logical-shape param pytrees with
+per-leaf ``PartitionSpec``s (tp: column/row kernels, ``ops.tp``; ep:
+expert-stacked leaves, ``ops.moe``; pp: depth-stacked block leaves,
+``ops.pipeline``). The leaf classification is always a regex over the flax
+param path; this module holds the common walk so the three placement
+contracts cannot drift.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def path_str(path) -> str:
+    """A flax param path as ``"Module_0/sub/leaf"`` (tree_util key path)."""
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def leading_dim_specs(params: Any, leaf_regex: re.Pattern, axis: str) -> Any:
+    """Per-leaf ``PartitionSpec`` pytree: leaves whose path matches
+    ``leaf_regex`` split their LEADING dim over ``axis``; everything else
+    replicated. Leaves keep full logical shapes — only placement differs."""
+
+    def spec(path, leaf):
+        if leaf_regex.search(path_str(path)):
+            return P(*([axis] + [None] * (leaf.ndim - 1)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

@@ -31,6 +31,7 @@ from typing import Any
 import jax
 from jax.sharding import PartitionSpec as P
 
+from p2pdl_tpu.ops.placement import path_str as _path_str
 from p2pdl_tpu.parallel.mesh import TP_AXIS
 
 # Leaf-path classification for the ViT tree (flax auto-naming:
@@ -44,12 +45,6 @@ _ROW_KERNEL = re.compile(
     r"(MultiHeadAttention_\d+/Dense_1|TransformerBlock_\d+/Dense_1)/kernel$"
 )
 _ROW_BIAS = re.compile(r"TransformerBlock_\d+/Dense_1/bias$")
-
-
-def _path_str(path) -> str:
-    return "/".join(
-        str(getattr(k, "key", getattr(k, "idx", k))) for k in path
-    )
 
 
 def param_specs(params: Any, tp_axis: str = TP_AXIS) -> Any:

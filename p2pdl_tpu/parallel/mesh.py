@@ -21,6 +21,14 @@ SEQ_AXIS = "seq"
 # Second mesh axis for tensor parallelism: with ``tp_shards > 1`` the grid
 # is (peers x tp); attention heads + MLP hidden shard over it (ops/tp.py).
 TP_AXIS = "tp"
+# Second mesh axis for expert parallelism: with ``ep_shards > 1`` the grid
+# is (peers x ep); MoE expert weights shard over it and tokens move by
+# ``all_to_all`` (ops/moe.py).
+EP_AXIS = "ep"
+# Second mesh axis for pipeline parallelism: with ``pp_shards > 1`` the grid
+# is (peers x pp); transformer depth shards over it and microbatch
+# activations rotate by ``ppermute`` (ops/pipeline.py).
+PP_AXIS = "pp"
 
 
 def make_mesh(
@@ -28,12 +36,28 @@ def make_mesh(
     devices=None,
     seq_shards: int = 1,
     tp_shards: int = 1,
+    ep_shards: int = 1,
+    pp_shards: int = 1,
 ) -> Mesh:
-    """A mesh named ``("peers",)`` — or ``("peers", "seq")`` /
-    ``("peers", "tp")`` when sequence or tensor parallelism splits the
+    """A mesh named ``("peers",)`` — or 2-D ``("peers", <axis>)`` when one of
+    sequence / tensor / expert / pipeline parallelism splits the
     ``n_devices`` grid (``n_peer_devices = n_devices // shards``)."""
-    if seq_shards > 1 and tp_shards > 1:
-        raise ValueError("seq_shards and tp_shards are currently exclusive")
+    requested = [
+        (shards, axis)
+        for shards, axis in (
+            (seq_shards, SEQ_AXIS),
+            (tp_shards, TP_AXIS),
+            (ep_shards, EP_AXIS),
+            (pp_shards, PP_AXIS),
+        )
+        if shards > 1
+    ]
+    if len(requested) > 1:
+        names = ", ".join(axis for _, axis in requested)
+        raise ValueError(
+            f"model-parallel axes are currently exclusive (one second mesh "
+            f"axis at a time); requested {names}"
+        )
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
@@ -41,11 +65,9 @@ def make_mesh(
             raise ValueError(f"requested {n_devices} devices, have {len(devices)}")
         devices = devices[:n_devices]
     devices = np.asarray(devices)
-    shards, axis = max(seq_shards, 1), SEQ_AXIS
-    if tp_shards > 1:
-        shards, axis = tp_shards, TP_AXIS
-    if shards <= 1:
+    if not requested:
         return Mesh(devices, (PEER_AXIS,))
+    shards, axis = requested[0]
     if devices.size % shards != 0:
         raise ValueError(
             f"{axis}_shards ({shards}) must divide the device count ({devices.size})"

@@ -72,12 +72,20 @@ def make_optimizer(cfg: Config) -> optax.GradientTransformation:
     return optax.sgd(cfg.lr)
 
 
-def build_model(cfg: Config, seq_axis: str | None = None, tp_axis: str | None = None):
-    """Build the configured model. ``seq_axis`` / ``tp_axis`` name the mesh
-    axes the token sequence / heads+MLP-hidden are sharded over (only inside
-    ``shard_map``); the default ``None`` is the dense twin — same logical
-    param pytree, so init and eval share one model while the compiled round
-    runs the parallel one."""
+def build_model(
+    cfg: Config,
+    seq_axis: str | None = None,
+    tp_axis: str | None = None,
+    ep_axis: str | None = None,
+    pp_axis: str | None = None,
+):
+    """Build the configured model. ``seq_axis`` / ``tp_axis`` / ``ep_axis`` /
+    ``pp_axis`` name the mesh axes the token sequence / heads+MLP-hidden /
+    MoE experts / trunk depth are sharded over (only inside ``shard_map``);
+    the default ``None`` is the dense twin — same logical param pytree, so
+    init and eval share one model while the compiled round runs the parallel
+    one. (With ``cfg.pp_shards > 1`` the dense twin still uses the
+    scan-blocks stacked layout so the pytrees match.)"""
     kwargs: dict[str, Any] = {}
     if cfg.model == "char_lstm":
         from p2pdl_tpu.data.synthetic import SHAKESPEARE_VOCAB_SIZE
@@ -87,11 +95,25 @@ def build_model(cfg: Config, seq_axis: str | None = None, tp_axis: str | None = 
         kwargs["attn_impl"] = cfg.attn_impl
         kwargs["pool"] = cfg.vit_pool
         kwargs["heads"] = cfg.vit_heads
+        kwargs["depth"] = cfg.vit_depth
+        if cfg.moe_experts > 0:
+            kwargs["moe_experts"] = cfg.moe_experts
+            kwargs["moe_every"] = cfg.moe_every
+            kwargs["moe_capacity_factor"] = cfg.moe_capacity_factor
         if seq_axis is not None:
             kwargs["seq_axis"] = seq_axis
         if tp_axis is not None:
             kwargs["tp_axis"] = tp_axis
             kwargs["tp_shards"] = cfg.tp_shards
+        if ep_axis is not None:
+            kwargs["ep_axis"] = ep_axis
+            kwargs["ep_shards"] = cfg.ep_shards
+        if cfg.uses_scan_blocks:
+            kwargs["scan_blocks"] = True
+            kwargs["pp_microbatches"] = cfg.effective_pp_microbatches
+            if pp_axis is not None:
+                kwargs["pp_axis"] = pp_axis
+                kwargs["pp_shards"] = cfg.pp_shards
     return get_model(cfg.model, **kwargs)
 
 
@@ -127,20 +149,27 @@ def init_peer_state(cfg: Config, key: jax.Array | None = None) -> PeerState:
 def shard_state(state: PeerState, cfg: Config, mesh) -> PeerState:
     """Place a ``PeerState`` on the mesh with the layout-correct shardings.
 
-    Under tensor parallelism the sync-layout params get PER-LEAF placements
-    (column/row kernels split over the tp axis, ``ops.tp.param_specs``) —
-    the leaves keep their full logical shapes; only bytes move."""
+    Under tensor / expert parallelism the sync-layout params get PER-LEAF
+    placements (column/row kernels split over the tp axis,
+    ``ops.tp.param_specs``; expert-stacked leaves split over the ep axis,
+    ``ops.moe.param_specs``) — the leaves keep their full logical shapes;
+    only bytes move."""
     from jax.sharding import NamedSharding
 
     ps = peer_sharding(mesh)
     rs = replicated_sharding(mesh)
     layout = params_layout(cfg)
-    if cfg.tp_shards > 1 and layout == "sync":
-        from p2pdl_tpu.ops import tp
+    if (cfg.tp_shards > 1 or cfg.ep_shards > 1 or cfg.pp_shards > 1) and layout == "sync":
+        if cfg.tp_shards > 1:
+            from p2pdl_tpu.ops import tp as _placer
+        elif cfg.ep_shards > 1:
+            from p2pdl_tpu.ops import moe as _placer
+        else:
+            from p2pdl_tpu.ops import pipeline as _placer
 
         param_shardings = jax.tree.map(
             lambda spec: NamedSharding(mesh, spec),
-            tp.param_specs(state.params),
+            _placer.param_specs(state.params),
             is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
         )
     else:

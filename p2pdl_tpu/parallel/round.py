@@ -51,7 +51,14 @@ from p2pdl_tpu.ops import aggregators, sharded_aggregators
 from p2pdl_tpu.ops.attacks import apply_attack
 from p2pdl_tpu.ops.gossip import ring_mix
 from p2pdl_tpu.ops.secure_agg import apply_masks
-from p2pdl_tpu.parallel.mesh import PEER_AXIS, SEQ_AXIS, TP_AXIS, peers_per_device
+from p2pdl_tpu.parallel.mesh import (
+    EP_AXIS,
+    PEER_AXIS,
+    PP_AXIS,
+    SEQ_AXIS,
+    TP_AXIS,
+    peers_per_device,
+)
 from p2pdl_tpu.parallel.peer_state import (
     PeerState,
     build_model,
@@ -62,17 +69,27 @@ from p2pdl_tpu.parallel.peer_state import (
 )
 
 
-def _mesh_axes_for(cfg: Config, mesh: Mesh) -> tuple[str | None, str | None]:
-    """(seq_axis, tp_axis) for this config, validated against the mesh."""
+def _mesh_axes_for(
+    cfg: Config, mesh: Mesh
+) -> tuple[str | None, str | None, str | None, str | None]:
+    """(seq_axis, tp_axis, ep_axis, pp_axis) for this config, validated
+    against the mesh."""
     seq_axis = SEQ_AXIS if cfg.seq_shards > 1 else None
     tp_axis = TP_AXIS if cfg.tp_shards > 1 else None
-    for axis, knob in ((seq_axis, "seq_shards"), (tp_axis, "tp_shards")):
+    ep_axis = EP_AXIS if cfg.ep_shards > 1 else None
+    pp_axis = PP_AXIS if cfg.pp_shards > 1 else None
+    for axis, knob in (
+        (seq_axis, "seq_shards"),
+        (tp_axis, "tp_shards"),
+        (ep_axis, "ep_shards"),
+        (pp_axis, "pp_shards"),
+    ):
         if axis is not None and axis not in mesh.shape:
             raise ValueError(
                 f"cfg.{knob}={getattr(cfg, knob)} needs a (peers x {axis}) "
                 f"mesh; build it with make_mesh({knob}=...)"
             )
-    return seq_axis, tp_axis
+    return seq_axis, tp_axis, ep_axis, pp_axis
 
 
 def _tp_params_spec(cfg: Config):
@@ -82,6 +99,25 @@ def _tp_params_spec(cfg: Config):
 
     abstract = jax.eval_shape(lambda: init_peer_state(cfg)).params
     return tp.param_specs(abstract)
+
+
+def _ep_params_spec(cfg: Config):
+    """Per-leaf PartitionSpec tree for expert-parallel params (full logical
+    shapes, expert-stacked leaves split over the ep axis — ``ops.moe``)."""
+    from p2pdl_tpu.ops import moe
+
+    abstract = jax.eval_shape(lambda: init_peer_state(cfg)).params
+    return moe.param_specs(abstract)
+
+
+def _pp_params_spec(cfg: Config):
+    """Per-leaf PartitionSpec tree for pipeline-parallel params (full
+    logical shapes, depth-stacked block leaves split over the pp axis —
+    ``ops.pipeline``)."""
+    from p2pdl_tpu.ops import pipeline
+
+    abstract = jax.eval_shape(lambda: init_peer_state(cfg)).params
+    return pipeline.param_specs(abstract)
 
 
 def make_forward_fn(
@@ -137,6 +173,7 @@ def make_local_train(
     model: Any,
     opt: optax.GradientTransformation,
     seq_axis: str | None = None,
+    ep_axis: str | None = None,
 ) -> Callable:
     """One peer's full local-training phase (``cfg.local_epochs`` epochs of
     minibatch SGD, reshuffled per epoch) as a pure function — the jittable
@@ -148,9 +185,30 @@ def make_local_train(
     invariant->varying boundary — each shard's token-block contribution is
     summed once, and layers computing in the already-invariant region after
     the pooling ``pmean`` are not double-counted. (``seq_axis`` is accepted
-    for signature symmetry; the psum is implicit.)"""
+    for signature symmetry; the psum is implicit.)
+
+    Under expert parallelism (``ep_axis`` set) each shard trains on ITS
+    ``batch_size / ep_shards`` slice of every batch (tokens reach their
+    expert's owner by all_to_all inside the model) and the local loss is
+    pre-scaled by ``1 / ep_shards``: non-expert params stay ep-invariant,
+    so the implicit psum of their grads over the ep axis then reconstructs
+    exactly the global-batch mean; expert params are ep-varying and their
+    grads arrive complete through the all_to_all transpose. The reported
+    loss is the scaled local mean — callers psum it over the ep axis to
+    recover the true batch loss (``_local_train_phase`` does)."""
     del seq_axis  # implicit via vma typing; see docstring
     loss_fn = make_loss_fn(model, jnp.dtype(cfg.compute_dtype), _param_transform(cfg))
+    if ep_axis is not None:
+        inner = loss_fn
+        ep_shards = cfg.ep_shards
+        b_local = cfg.batch_size // ep_shards
+
+        def loss_fn(params, xb, yb):  # noqa: F811 - deliberate wrap
+            start = lax.axis_index(ep_axis) * b_local
+            xs = lax.dynamic_slice_in_dim(xb, start, b_local, axis=0)
+            ys = lax.dynamic_slice_in_dim(yb, start, b_local, axis=0)
+            return inner(params, xs, ys) / ep_shards
+
     if cfg.remat:
         loss_fn = jax.checkpoint(loss_fn)
     grad_fn = jax.value_and_grad(loss_fn)
@@ -159,8 +217,10 @@ def make_local_train(
     b = cfg.batch_size
     # With exactly one full-shard batch per epoch, the shuffle only permutes
     # rows *within* the batch — the mean gradient is permutation-invariant —
-    # so the gather (a full copy of x per step) is skipped.
-    shuffle = not (nb == 1 and nb * b == s)
+    # so the gather (a full copy of x per step) is skipped. (Under expert
+    # parallelism rows map to ep shards positionally, so the permutation is
+    # no longer a no-op and the gather stays.)
+    shuffle = not (nb == 1 and nb * b == s and ep_axis is None)
 
     def local_train(params, opt_state, key, x, y):
         def epoch(carry, ekey):
@@ -233,6 +293,8 @@ def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
         and not cfg.remat
         and cfg.seq_shards == 1
         and cfg.tp_shards == 1
+        and cfg.ep_shards == 1
+        and cfg.pp_shards == 1
         and cfg.momentum == 0.0
         and cfg.local_epochs == 1
         and cfg.batches_per_epoch == 1
@@ -263,8 +325,10 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
     The input ``state`` is donated: the round overwrites it in place, so the
     caller must use the returned state (all call sites thread it through).
     """
-    seq_axis, tp_axis = _mesh_axes_for(cfg, mesh)
-    model = build_model(cfg, seq_axis=seq_axis, tp_axis=tp_axis)
+    seq_axis, tp_axis, ep_axis, pp_axis = _mesh_axes_for(cfg, mesh)
+    model = build_model(
+        cfg, seq_axis=seq_axis, tp_axis=tp_axis, ep_axis=ep_axis, pp_axis=pp_axis
+    )
     opt = make_optimizer(cfg)
     l_per_dev = peers_per_device(cfg.num_peers, mesh)
     emit_delta = False
@@ -276,11 +340,19 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
         body = _fast_sync_body(cfg, model, l_per_dev)
         params_spec = P()
     else:
-        body = _general_sync_body(cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis)
+        body = _general_sync_body(
+            cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis, ep_axis=ep_axis
+        )
         params_spec = P()
     if tp_axis is not None:
         # Per-leaf placement: column/row kernels split over the tp axis.
         params_spec = _tp_params_spec(cfg)
+    if ep_axis is not None:
+        # Per-leaf placement: expert-stacked leaves split over the ep axis.
+        params_spec = _ep_params_spec(cfg)
+    if pp_axis is not None:
+        # Per-leaf placement: depth-stacked block leaves split over pp.
+        params_spec = _pp_params_spec(cfg)
 
     sp = P(PEER_AXIS)
     sr = P()
@@ -344,8 +416,10 @@ def build_multi_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Calla
     """
     if cfg.brb_enabled:
         raise ValueError("fused rounds cannot host the BRB trust plane between phases")
-    seq_axis, tp_axis = _mesh_axes_for(cfg, mesh)
-    model = build_model(cfg, seq_axis=seq_axis, tp_axis=tp_axis)
+    seq_axis, tp_axis, ep_axis, pp_axis = _mesh_axes_for(cfg, mesh)
+    model = build_model(
+        cfg, seq_axis=seq_axis, tp_axis=tp_axis, ep_axis=ep_axis, pp_axis=pp_axis
+    )
     opt = make_optimizer(cfg)
     l_per_dev = peers_per_device(cfg.num_peers, mesh)
     if params_layout(cfg) == "peer":
@@ -355,10 +429,16 @@ def build_multi_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Calla
         body = _fast_sync_body(cfg, model, l_per_dev)
         params_spec = P()
     else:
-        body = _general_sync_body(cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis)
+        body = _general_sync_body(
+            cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis, ep_axis=ep_axis
+        )
         params_spec = P()
     if tp_axis is not None:
         params_spec = _tp_params_spec(cfg)
+    if ep_axis is not None:
+        params_spec = _ep_params_spec(cfg)
+    if pp_axis is not None:
+        params_spec = _pp_params_spec(cfg)
 
     def multi_body(params, opt_state, rng, x, y, trainer_mat, byz_gate, round0, base_key):
         def step(carry, inputs):
@@ -558,12 +638,12 @@ def _fast_sync_body(cfg, model, l_per_dev):
     return body
 
 
-def _local_train_phase(cfg, attack, model, opt, l_per_dev, seq_axis=None):
+def _local_train_phase(cfg, attack, model, opt, l_per_dev, seq_axis=None, ep_axis=None):
     """Phase fragment (inside ``shard_map``): every peer's local SGD from the
     replicated global params, returning the (possibly attacked) per-peer
     deltas — the round up to the point where the reference's trainer ships
     its update (reference ``node/node.py:265-297``)."""
-    local_train = make_local_train(cfg, model, opt, seq_axis=seq_axis)
+    local_train = make_local_train(cfg, model, opt, seq_axis=seq_axis, ep_axis=ep_axis)
 
     def phase(params, opt_state, rng, x, y, byz_gate, round_idx, mask_key):
         dev = lax.axis_index(PEER_AXIS)
@@ -575,11 +655,17 @@ def _local_train_phase(cfg, attack, model, opt, l_per_dev, seq_axis=None):
         # local gradients into the global sum. Along the SEQ axis that
         # implicit psum is exactly the desired semantics (sum the shards'
         # token-block gradient contributions), so params stay seq-invariant.
+        # Likewise along the EP axis for the non-expert leaves (the expert
+        # leaves enter ep-varying via their P(ep) placement and stay so).
         pvaried = jax.lax.pcast(params, PEER_AXIS, to="varying")
         new_params, new_opt, losses = jax.vmap(
             local_train, in_axes=(None, 0, 0, 0, 0)
         )(pvaried, opt_state, round_keys, x, y)
 
+        if ep_axis is not None:
+            # local_train reports its 1/ep-scaled shard-slice loss mean;
+            # the sum over ep shards is the true batch loss.
+            losses = lax.psum(losses, ep_axis)
         delta = jax.tree.map(lambda n, p: n - p[None], new_params, pvaried)
         gate = byz_gate[local_ids]
         delta = apply_attack(attack, delta, gate, jax.random.fold_in(mask_key, dev))
@@ -660,12 +746,14 @@ def _aggregate_phase(cfg, l_per_dev):
     return phase
 
 
-def _general_sync_body(cfg, attack, model, opt, l_per_dev, seq_axis=None):
+def _general_sync_body(cfg, attack, model, opt, l_per_dev, seq_axis=None, ep_axis=None):
     """Role-based round over single-copy global params: broadcast the global
     model into a vmapped local-SGD phase (peers diverge only transiently),
     aggregate trainer deltas, apply one deterministic server update. One
     fused program = the two phase fragments composed with no host boundary."""
-    train = _local_train_phase(cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis)
+    train = _local_train_phase(
+        cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis, ep_axis=ep_axis
+    )
     agg = _aggregate_phase(cfg, l_per_dev)
 
     def body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):

@@ -226,7 +226,11 @@ class Experiment:
         self.failure_cooldown_rounds = failure_cooldown_rounds
         self._suspect_until: dict[int, int] = {}
         self.mesh = make_mesh(
-            n_devices, seq_shards=cfg.seq_shards, tp_shards=cfg.tp_shards
+            n_devices,
+            seq_shards=cfg.seq_shards,
+            tp_shards=cfg.tp_shards,
+            ep_shards=cfg.ep_shards,
+            pp_shards=cfg.pp_shards,
         )
         self.data = make_federated_data(cfg)
         # Sync layouts with the trust plane on use the split (two-program)

@@ -1,0 +1,191 @@
+"""Expert parallelism: MoE experts sharded over an ``ep`` mesh axis.
+
+Invariant under test everywhere: with dropless capacity, EP is a LAYOUT
+choice, not an algorithm change — the ep-sharded MoE layer/round must
+reproduce its dense twin exactly (forward, gradients, and a full federated
+round), with the parameter pytree unchanged (full logical ``[E, ...]``
+shapes, per-leaf placement only). Routing (top-1 dispatch, capacity,
+dropping) is additionally pinned at the unit level.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.data import make_federated_data
+from p2pdl_tpu.models.vit import ViTTiny
+from p2pdl_tpu.ops import moe
+from p2pdl_tpu.parallel import (
+    build_eval_fn,
+    build_round_fn,
+    init_peer_state,
+    shard_state,
+)
+from p2pdl_tpu.parallel.mesh import make_mesh, peer_sharding
+
+
+def test_top1_route_dispatch_and_capacity():
+    """Unit level: every token lands in exactly one (expert, slot); slots
+    fill in token order; tokens past capacity are marked dropped."""
+    logits = jnp.asarray(
+        [
+            [9.0, 0.0, 0.0],  # -> expert 0, slot 0
+            [8.0, 0.0, 0.0],  # -> expert 0, slot 1
+            [7.0, 0.0, 0.0],  # -> expert 0, over capacity 2: DROPPED
+            [0.0, 5.0, 0.0],  # -> expert 1, slot 0
+        ]
+    )
+    expert, slot, keep, prob = moe.top1_route(logits, capacity=2)
+    np.testing.assert_array_equal(np.asarray(expert), [0, 0, 0, 1])
+    np.testing.assert_array_equal(np.asarray(slot), [0, 1, 1, 0])
+    np.testing.assert_array_equal(np.asarray(keep), [True, True, False, True])
+    # Admitted (expert, slot) pairs are unique — the scatter's invariant.
+    admitted = [(int(e), int(s)) for e, s, k in zip(expert, slot, keep) if k]
+    assert len(admitted) == len(set(admitted))
+    probs = jax.nn.softmax(logits, axis=-1)
+    np.testing.assert_allclose(
+        float(prob[0]), float(probs[0, 0]), rtol=1e-6
+    )
+
+
+def test_moe_ffn_ep_matches_dense():
+    """Library level: the ep-sharded MoE FFN (4-way expert split) equals its
+    dense twin on the SAME param tree — forward and all parameter grads —
+    when capacity makes dropping impossible."""
+    E, D, H, ep = 4, 16, 32, 4
+    dense = moe.MoEFFN(num_experts=E, dim=D, hidden=H, capacity_factor=float(E))
+    epm = moe.MoEFFN(
+        num_experts=E, dim=D, hidden=H, capacity_factor=float(E),
+        ep_axis="ep", ep_shards=ep,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 6, D), jnp.float32)
+    params = dense.init(jax.random.PRNGKey(1), x)["params"]
+    mesh = Mesh(np.asarray(jax.devices()[:ep]), ("ep",))
+    smapped = jax.jit(
+        jax.shard_map(
+            lambda p, xx: epm.apply({"params": p}, xx),
+            mesh=mesh,
+            in_specs=(moe.param_specs(params, "ep"), P("ep")),
+            out_specs=P("ep"),
+        )
+    )
+    want = dense.apply({"params": params}, x)
+    got = smapped(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    g_d = jax.grad(lambda p: jnp.sum(dense.apply({"params": p}, x) ** 2))(params)
+    g_e = jax.grad(lambda p: jnp.sum(smapped(p, x) ** 2))(params)
+    for k in g_d:
+        np.testing.assert_allclose(
+            np.asarray(g_e[k]), np.asarray(g_d[k]), atol=1e-4, err_msg=k
+        )
+
+
+def test_moe_vit_forward_has_expert_grads():
+    """The MoE ViT trains all its parts: gate and every expert receive
+    nonzero gradients (top-1 routing spreads tokens across experts at
+    init because the gate is randomly initialized)."""
+    model = ViTTiny(depth=2, moe_experts=4, moe_every=2, pool="mean")
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 32, 32, 3), jnp.float32)
+    params = model.init(jax.random.PRNGKey(1), x)["params"]
+    moe_params = params["TransformerBlock_1"]["MoEFFN_0"]
+    assert moe_params["wi"].shape == (4, 192, 768)
+    g = jax.grad(lambda p: jnp.sum(model.apply({"params": p}, x) ** 2))(params)
+    g_moe = g["TransformerBlock_1"]["MoEFFN_0"]
+    assert float(jnp.sum(jnp.abs(g_moe["gate"]))) > 0.0
+    # Block 0 keeps its dense MLP (moe_every=2 -> blocks 1, 3, ... are MoE).
+    assert "MoEFFN_0" not in params["TransformerBlock_0"]
+
+
+def test_ep_round_matches_dense(mesh8):
+    """Framework level: cfg.ep_shards=2 runs the SAME federated round over a
+    (peers x ep) mesh — expert leaves per-leaf sharded, tokens moved by
+    all_to_all — with results equal to the dense round."""
+    base = Config(
+        num_peers=4,
+        trainers_per_round=2,
+        local_epochs=1,
+        samples_per_peer=8,
+        batch_size=4,
+        model="vit_tiny",
+        dataset="cifar10",
+        moe_experts=4,
+        moe_capacity_factor=4.0,  # dropless: ep == dense exactly
+        compute_dtype="float32",
+        lr=0.05,
+        server_lr=1.0,
+    )
+    data = make_federated_data(base, eval_samples=16)
+    results, evals = {}, {}
+    for ep_shards in (1, 2):
+        cfg = base.replace(ep_shards=ep_shards)
+        mesh = make_mesh(8, ep_shards=ep_shards) if ep_shards > 1 else make_mesh(4)
+        state = shard_state(init_peer_state(cfg), cfg, mesh)
+        x = jax.device_put(data.x, peer_sharding(mesh))
+        y = jax.device_put(data.y, peer_sharding(mesh))
+        fn = build_round_fn(cfg, mesh)
+        state, m = fn(
+            state, x, y, jnp.asarray([0, 2], jnp.int32), jnp.zeros(4),
+            jax.random.PRNGKey(0),
+        )
+        results[ep_shards] = jax.tree.map(np.asarray, state.params)
+        evals[ep_shards] = float(
+            build_eval_fn(cfg)(state, data.eval_x, data.eval_y)["eval_loss"]
+        )
+        # Reported train losses are the true batch losses in both layouts.
+        results[f"loss{ep_shards}"] = np.asarray(m["train_loss"])
+    flat1 = jax.tree_util.tree_leaves_with_path(results[1])
+    flat2 = dict(
+        (jax.tree_util.keystr(p), l)
+        for p, l in jax.tree_util.tree_leaves_with_path(results[2])
+    )
+    for path, leaf in flat1:
+        np.testing.assert_allclose(
+            leaf, flat2[jax.tree_util.keystr(path)], atol=2e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+    np.testing.assert_allclose(results["loss1"], results["loss2"], atol=1e-5)
+    np.testing.assert_allclose(evals[1], evals[2], atol=1e-5)
+
+
+def test_ep_param_tree_unchanged(mesh8):
+    """EP must not change the param pytree: same treedef, same full logical
+    shapes — only placement differs."""
+    cfg = Config(
+        num_peers=4, trainers_per_round=2, samples_per_peer=8, batch_size=4,
+        model="vit_tiny", dataset="cifar10", moe_experts=4, ep_shards=2,
+    )
+    dense_state = init_peer_state(cfg.replace(ep_shards=1))
+    ep_state = shard_state(init_peer_state(cfg), cfg, make_mesh(8, ep_shards=2))
+    da, ta = jax.tree.leaves(dense_state.params), jax.tree.leaves(ep_state.params)
+    assert len(da) == len(ta)
+    for d, t in zip(da, ta):
+        assert d.shape == t.shape
+
+
+def test_ep_config_validation():
+    with pytest.raises(ValueError, match="transformer"):
+        Config(moe_experts=4, model="mlp")
+    with pytest.raises(ValueError, match="moe_experts"):
+        Config(ep_shards=2)  # ep without MoE
+    with pytest.raises(ValueError, match="divide moe_experts"):
+        Config(ep_shards=3, moe_experts=4, model="vit_tiny", dataset="cifar10")
+    with pytest.raises(ValueError, match="batch_size"):
+        Config(
+            ep_shards=2, moe_experts=4, model="vit_tiny", dataset="cifar10",
+            batch_size=31, samples_per_peer=31,
+        )
+    with pytest.raises(ValueError, match="momentum"):
+        Config(
+            ep_shards=2, moe_experts=4, model="vit_tiny", dataset="cifar10",
+            momentum=0.9,
+        )
+    with pytest.raises(ValueError, match="exclusive"):
+        Config(
+            ep_shards=2, seq_shards=2, moe_experts=4, model="vit_tiny",
+            dataset="cifar10", vit_pool="mean",
+        )
+    Config(ep_shards=2, moe_experts=4, model="vit_tiny", dataset="cifar10")
