@@ -30,6 +30,7 @@ Modes:
 
 from __future__ import annotations
 
+import functools
 import json
 import sys
 import time
@@ -298,6 +299,10 @@ def matrix_entries() -> list[dict]:
                 samples_per_peer=8, batch_size=8, model="vit_tiny",
                 dataset="cifar10", aggregator="secure_fedavg",
                 secure_agg_neighbors=8,
+                # 1024 transient ViT peer copies (~22 GB) cannot fit one
+                # chip: stream the peer stack in chunks of 32 with the
+                # masked-sum aggregation fused into the scan.
+                peer_chunk=32,
             ),
         },
         {
@@ -323,8 +328,19 @@ def matrix_entries() -> list[dict]:
     ]
 
 
-def bench_attention(seq_len: int, impl: str, iters: int = 20) -> float:
-    """Milliseconds per fwd+bwd of one attention layer at ``seq_len``."""
+def bench_attention(seq_len: int, impl: str, iters: int = 16) -> float:
+    """Milliseconds per fwd+bwd of one attention layer at ``seq_len``.
+
+    All ``iters`` steps run CHAINED INSIDE ONE compiled program
+    (``lax.fori_loop`` with each step's q depending on the previous grad),
+    and the reported time is the difference between an ``iters``-step and a
+    1-step dispatch. Host-loop timing is not trustworthy in this
+    environment: the remote-execution tunnel both adds tens of ms of
+    per-dispatch latency and can elide repeated identical dispatches, which
+    makes naive loops report pure overhead (or pure nothing). On-device
+    chaining defeats both."""
+    from jax import lax
+
     from p2pdl_tpu.ops.attention import sdpa
     from p2pdl_tpu.ops.pallas_attention import flash_attention
 
@@ -339,13 +355,30 @@ def bench_attention(seq_len: int, impl: str, iters: int = 20) -> float:
     def loss(q, k, v):
         return jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32) ** 2)
 
-    grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-    jax.block_until_ready(grad(q, k, v))  # compile
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = grad(q, k, v)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters * 1000.0
+    grad = jax.grad(loss, argnums=(0, 1, 2))
+
+    def chained(q, k, v, n):
+        # ALL THREE grads feed the carry — an unused dk/dv inside one jitted
+        # program would be dead-code-eliminated (for flash, that would drop
+        # the whole dk/dv pallas_call) and the metric would stop measuring
+        # the full backward.
+        def step(_, carry):
+            qq, kk, vv = carry
+            dq, dk, dv = grad(qq, kk, vv)
+            eps = jnp.bfloat16(1e-6)
+            return (qq + eps * dq, kk + eps * dk, vv + eps * dv)
+
+        out = lax.fori_loop(0, n, step, (q, k, v))
+        return sum(jnp.sum(o.astype(jnp.float32)) for o in out)
+
+    timings = {}
+    for n in (1, iters):
+        j = jax.jit(functools.partial(chained, n=n))
+        float(j(q, k, v))  # compile + one real sync (host readback)
+        t0 = time.perf_counter()
+        float(j(q, k, v))
+        timings[n] = time.perf_counter() - t0
+    return (timings[iters] - timings[1]) / (iters - 1) * 1000.0
 
 
 def run_matrix(timed_rounds: int = 10) -> list[dict]:

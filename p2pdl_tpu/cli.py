@@ -47,6 +47,14 @@ def build_parser() -> argparse.ArgumentParser:
         "k = k-regular ring graph (Bell et al.; scales to 1024+ trainers)",
     )
     p.add_argument(
+        "--peer-chunk",
+        type=int,
+        default=0,
+        help="stream the vmapped peer stack through chunks of this size "
+        "(O(chunk x model) transient HBM — fits 1024 ViT peers on one "
+        "chip); 0 = full vmap",
+    )
+    p.add_argument(
         "--robust-impl",
         choices=["blockwise", "gathered"],
         default="blockwise",
@@ -195,6 +203,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         multi_krum_m=args.multi_krum_m,
         robust_impl=args.robust_impl,
         secure_agg_neighbors=args.secure_agg_neighbors,
+        peer_chunk=args.peer_chunk,
         brb_enabled=args.brb,
         round_timeout_s=args.round_timeout_s,
         seed=args.seed,

@@ -82,6 +82,12 @@ class Config:
     # k-regular ring graph (Bell et al. 2020; O(T x k x model), scales to
     # 1024+ trainers; privacy holds unless all k neighbors collude).
     secure_agg_neighbors: int = 0
+    # Stream the vmapped peer stack through chunks of this size, fusing the
+    # masked-sum aggregation into the scan: peak transient HBM becomes
+    # O(peer_chunk x model) instead of O(peers_per_device x model) — how
+    # 1024 ViT peers fit one chip. 0 = off (full vmap). Mean family
+    # (fedavg/secure_fedavg) + plain SGD + BRB off only.
+    peer_chunk: int = 0
 
     # Trust plane (read by the host-side round driver/protocol layer; the
     # compiled round function itself is trust-agnostic).
@@ -291,6 +297,37 @@ class Config:
                     "seq_shards > 1 with the BRB trust plane is not yet "
                     "supported (the split-round digest path assumes a 1-D "
                     "peer mesh)"
+                )
+        if self.peer_chunk < 0:
+            raise ValueError(f"peer_chunk must be >= 0, got {self.peer_chunk}")
+        if self.peer_chunk > 0:
+            if self.aggregator not in ("fedavg", "secure_fedavg"):
+                raise ValueError(
+                    "peer_chunk requires a mean-family aggregator "
+                    "(fedavg/secure_fedavg): only a running sum can fuse "
+                    "into the chunk scan"
+                )
+            if (
+                self.seq_shards > 1
+                or self.tp_shards > 1
+                or self.ep_shards > 1
+                or self.pp_shards > 1
+            ):
+                raise ValueError(
+                    "peer_chunk does not compose with the model-parallel "
+                    "axes (seq/tp/ep/pp) yet — the chunked body trains "
+                    "each peer on the plain 1-D peer mesh"
+                )
+            if self.momentum != 0.0:
+                raise ValueError(
+                    "peer_chunk requires momentum=0.0 (per-peer optimizer "
+                    "state does not stream through the chunk scan)"
+                )
+            if self.brb_enabled:
+                raise ValueError(
+                    "peer_chunk with the BRB trust plane is not supported "
+                    "(the split-round path needs every peer's delta "
+                    "materialized for digesting)"
                 )
         if self.secure_agg_neighbors < 0:
             raise ValueError(
