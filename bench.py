@@ -306,6 +306,18 @@ def matrix_entries() -> list[dict]:
             ),
         },
         {
+            # Mixture-of-experts round: 8 experts, top-1 routing, scatter/
+            # gather dispatch — the MoE compute path on real hardware (the
+            # ep-sharded variant needs >= 2 chips; the math is identical,
+            # test-asserted equal).
+            "name": "cifar10_moe_vit_8peers_fedavg",
+            "cfg": Config(
+                num_peers=8, trainers_per_round=4, local_epochs=1,
+                samples_per_peer=16, batch_size=16, model="vit_tiny",
+                dataset="cifar10", moe_experts=8,
+            ),
+        },
+        {
             # End-to-end fused-attention round: the Pallas kernels compiled
             # by Mosaic inside the full federated round (the microbench
             # below times the kernels in isolation).

@@ -14,7 +14,12 @@ import sys
 
 
 def main() -> None:
-    pid, nproc, coord_port, base_port = (int(a) for a in sys.argv[1:5])
+    pid, nproc, coord_port = (int(a) for a in sys.argv[1:4])
+    # Explicit per-host trust-plane ports (comma-separated) — every port was
+    # actually reserved by the test runner; deriving neighbors as base+h
+    # could collide with the coordinator or an unrelated process.
+    tp_ports = [int(p) for p in sys.argv[4].split(",")]
+    assert len(tp_ports) == nproc, (tp_ports, nproc)
     equivocate = "--equivocate" in sys.argv
 
     import jax
@@ -76,7 +81,7 @@ def main() -> None:
         for t in my_trainers
     }
 
-    host_addrs = [("127.0.0.1", base_port + h) for h in range(nproc)]
+    host_addrs = [("127.0.0.1", p) for p in tp_ports]
     tp = multihost.MultiHostTrustPlane(cfg, topo, mesh, host_addrs)
     try:
         # Generous window: the hosts reach the exchange at different times

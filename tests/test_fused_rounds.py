@@ -35,9 +35,16 @@ CFG = Config(
 )
 
 
-@pytest.mark.parametrize("aggregator", ["fedavg", "gossip"])
-def test_fused_equals_sequential(mesh8, aggregator):
-    cfg = CFG.replace(aggregator=aggregator)
+# The peer_chunk case pins that the chunked-streaming body composes with
+# fused execution (local_epochs > 1 momentum-free config, 2 peers/device).
+@pytest.mark.parametrize(
+    "aggregator,peer_chunk,num_peers",
+    [("fedavg", 0, 8), ("gossip", 0, 8), ("fedavg", 2, 16)],
+)
+def test_fused_equals_sequential(mesh8, aggregator, peer_chunk, num_peers):
+    cfg = CFG.replace(
+        aggregator=aggregator, peer_chunk=peer_chunk, num_peers=num_peers
+    )
     data = make_federated_data(cfg, eval_samples=16)
     sh = peer_sharding(mesh8)
     x = jax.device_put(data.x, sh)
@@ -46,7 +53,10 @@ def test_fused_equals_sequential(mesh8, aggregator):
     base_key = jax.random.PRNGKey(cfg.seed)
     rounds = 4
     trainer_mat = np.stack(
-        [np.sort(np.random.default_rng(r).choice(8, 3, replace=False)) for r in range(rounds)]
+        [
+            np.sort(np.random.default_rng(r).choice(cfg.num_peers, 3, replace=False))
+            for r in range(rounds)
+        ]
     )
 
     seq_state = shard_state(init_peer_state(cfg), cfg, mesh8)

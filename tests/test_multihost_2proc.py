@@ -1,7 +1,7 @@
-"""Real 2-process multi-host execution: one BRB-gated round end-to-end.
+"""Real multi-process multi-host execution: one BRB-gated round end-to-end.
 
-Two OS processes join one ``jax.distributed`` job (CPU backend, 2 virtual
-devices each, gloo collectives), build the 4-device global peer mesh, and
+Two (and four) OS processes join one ``jax.distributed`` job (CPU backend,
+2 virtual devices each, gloo collectives), build the global peer mesh, and
 run a full federated round where the data-plane aggregate is a genuine
 cross-process ``psum`` and the trust plane rides ``TCPTransport`` between
 the hosts (``runtime.multihost.MultiHostTrustPlane``). This is the honest
@@ -33,8 +33,11 @@ def _free_ports(n: int) -> list[int]:
     return ports
 
 
-def _run_workers(extra: tuple[str, ...] = ()) -> list[dict]:
-    coord, base0, base1 = _free_ports(3)
+def _run_workers(extra: tuple[str, ...] = (), nproc: int = 2) -> list[dict]:
+    # One coordinator port + nproc trust-plane listener ports, every one
+    # actually reserved (workers get the explicit list — no base+h
+    # derivation that could land on the coordinator's port).
+    coord, *tp_ports = _free_ports(1 + nproc)
     env = os.environ.copy()
     # The pytest process forces an 8-device CPU platform via XLA_FLAGS; the
     # workers configure their own 2-device topology, so strip the flag.
@@ -46,14 +49,17 @@ def _run_workers(extra: tuple[str, ...] = ()) -> list[dict]:
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(i), "2", str(coord), str(base0), *extra],
+            [
+                sys.executable, WORKER, str(i), str(nproc), str(coord),
+                ",".join(str(p) for p in tp_ports), *extra,
+            ],
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
             env=env,
             cwd=REPO,
         )
-        for i in range(2)
+        for i in range(nproc)
     ]
     outs = []
     for p in procs:
@@ -91,3 +97,19 @@ def test_two_process_equivocator_gated_out():
         assert r["verified"] == [2, 5, 7]
         assert 0 not in r["verified"]
     assert a["checksum"] == b["checksum"]
+
+
+def test_four_process_round_end_to_end():
+    """The same BRB-gated round across FOUR OS processes (8 global devices,
+    1 peer each): echo/ready quorums and per-host delivery reports at
+    n_hosts > 2, one cross-process psum aggregate, identical replicated
+    params on every host."""
+    outs = _run_workers(nproc=4)
+    for r in outs:
+        assert r["devices"] == 8
+        assert r["local_devices"] == 2
+        assert r["failed"] == []
+        assert r["verified"] == [0, 2, 5, 7]
+        assert r["local_loss_finite"]
+    checksums = {r["checksum"] for r in outs}
+    assert len(checksums) == 1, f"hosts diverged: {checksums}"
