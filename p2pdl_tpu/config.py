@@ -118,7 +118,8 @@ class Config:
     vit_depth: int = 12
     # Tensor parallelism: shard attention heads + MLP hidden over a mesh
     # axis of this size (megatron column/row decomposition, ops/tp.py).
-    # 1 = off. Requires vit_tiny, tp_shards | vit_heads, plain SGD.
+    # 1 = off. Requires vit_tiny and tp_shards | vit_heads; momentum works
+    # (the optimizer trace gets the params' per-leaf placement).
     tp_shards: int = 1
     # Mixture-of-experts: replace the MLP of every ``moe_every``-th ViT
     # block with a top-1 (Switch) mixture of ``moe_experts`` experts
@@ -132,12 +133,11 @@ class Config:
     # Expert parallelism: shard the experts over a mesh axis of this size;
     # each peer's batch splits over the same axis and tokens reach their
     # expert's owner by all_to_all. 1 = off. Requires moe_experts > 0,
-    # ep_shards | moe_experts, ep_shards | batch_size, plain SGD.
+    # ep_shards | moe_experts, ep_shards | batch_size.
     ep_shards: int = 1
     # Pipeline parallelism: shard the ViT trunk's depth over a mesh axis of
     # this size (nn.scan-stacked blocks, microbatch ppermute schedule —
-    # ops/pipeline.py). 1 = off. Requires vit_tiny, pp_shards | depth,
-    # plain SGD.
+    # ops/pipeline.py). 1 = off. Requires vit_tiny and pp_shards | depth.
     pp_shards: int = 1
     # Microbatches per batch for the pipeline schedule; 0 = pp_shards.
     pp_microbatches: int = 0
@@ -386,11 +386,6 @@ class Config:
             raise ValueError(
                 f"model-parallel mesh axes are currently exclusive (one "
                 f"second mesh axis at a time); requested {', '.join(active)}"
-            )
-        if self.momentum != 0.0:
-            raise ValueError(
-                f"{knob} > 1 requires momentum=0.0 (optimizer state "
-                f"sharding over the second mesh axis is not yet implemented)"
             )
         if self.brb_enabled:
             raise ValueError(

@@ -33,3 +33,31 @@ def leading_dim_specs(params: Any, leaf_regex: re.Pattern, axis: str) -> Any:
         return P()
 
     return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def derived_tree_specs(tree: Any, param_specs: Any, stack_axis: str) -> Any:
+    """Per-leaf specs for a params-DERIVED, peer-stacked pytree — optimizer
+    state: momentum traces mirror the param tree, so each leaf's path ENDS
+    with some param's path. Such a leaf is that param stacked on a leading
+    peer dim, and its placement is ``P(stack_axis, *param_spec)``. Leaves
+    matching no param (step counts etc.) stack plainly: ``P(stack_axis)``
+    if arrayed, replicated if scalar. Longest-suffix wins, so a nested
+    param path shadows any shorter one it contains."""
+    by_path = sorted(
+        (
+            (path_str(p), s)
+            for p, s in jax.tree_util.tree_leaves_with_path(
+                param_specs, is_leaf=lambda x: isinstance(x, P)
+            )
+        ),
+        key=lambda kv: -len(kv[0]),
+    )
+
+    def spec(path, leaf):
+        ps = path_str(path)
+        for ppath, pspec in by_path:
+            if ps == ppath or ps.endswith("/" + ppath):
+                return P(stack_axis, *pspec)
+        return P(stack_axis) if getattr(leaf, "ndim", 0) >= 1 else P()
+
+    return jax.tree_util.tree_map_with_path(spec, tree)

@@ -159,7 +159,13 @@ def shard_state(state: PeerState, cfg: Config, mesh) -> PeerState:
     ps = peer_sharding(mesh)
     rs = replicated_sharding(mesh)
     layout = params_layout(cfg)
+    opt_shardings = jax.tree.map(
+        lambda l: ps if getattr(l, "ndim", 0) >= 1 else rs, state.opt_state
+    )
     if (cfg.tp_shards > 1 or cfg.ep_shards > 1 or cfg.pp_shards > 1) and layout == "sync":
+        from p2pdl_tpu.ops.placement import derived_tree_specs
+        from p2pdl_tpu.parallel.mesh import PEER_AXIS
+
         if cfg.tp_shards > 1:
             from p2pdl_tpu.ops import tp as _placer
         elif cfg.ep_shards > 1:
@@ -167,10 +173,17 @@ def shard_state(state: PeerState, cfg: Config, mesh) -> PeerState:
         else:
             from p2pdl_tpu.ops import pipeline as _placer
 
+        param_specs = _placer.param_specs(state.params)
+        is_spec = lambda x: isinstance(x, jax.sharding.PartitionSpec)  # noqa: E731
         param_shardings = jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec), param_specs, is_leaf=is_spec
+        )
+        # Optimizer state mirrors the params (momentum traces): peer axis +
+        # the matching param's spec per leaf.
+        opt_shardings = jax.tree.map(
             lambda spec: NamedSharding(mesh, spec),
-            _placer.param_specs(state.params),
-            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+            derived_tree_specs(state.opt_state, param_specs, PEER_AXIS),
+            is_leaf=is_spec,
         )
     else:
         param_shardings = jax.tree.map(
@@ -178,9 +191,7 @@ def shard_state(state: PeerState, cfg: Config, mesh) -> PeerState:
         )
     shardings = PeerState(
         params=param_shardings,
-        opt_state=jax.tree.map(
-            lambda l: ps if getattr(l, "ndim", 0) >= 1 else rs, state.opt_state
-        ),
+        opt_state=opt_shardings,
         rng=ps,
         round_idx=rs,
     )

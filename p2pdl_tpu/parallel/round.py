@@ -92,32 +92,29 @@ def _mesh_axes_for(
     return seq_axis, tp_axis, ep_axis, pp_axis
 
 
-def _tp_params_spec(cfg: Config):
-    """Per-leaf PartitionSpec tree for tensor-parallel params (full logical
-    shapes, column/row kernels split over the tp axis — ``ops.tp``)."""
-    from p2pdl_tpu.ops import tp
+def _model_parallel_specs(cfg: Config, kind: str):
+    """(params_spec, opt_spec) per-leaf PartitionSpec trees for a
+    model-parallel layout (one abstract init trace shared by both):
 
-    abstract = jax.eval_shape(lambda: init_peer_state(cfg)).params
-    return tp.param_specs(abstract)
+    - params: full logical shapes; ``kind`` selects the placer — "tp"
+      (column/row kernels, ``ops.tp``), "ep" (expert-stacked leaves,
+      ``ops.moe``), "pp" (depth-stacked block leaves, ``ops.pipeline``);
+    - optimizer state: momentum traces mirror the param tree, so each
+      trace leaf is its param's spec with the peer axis prefixed
+      (``ops.placement.derived_tree_specs``)."""
+    from p2pdl_tpu.ops.placement import derived_tree_specs
 
+    if kind == "tp":
+        from p2pdl_tpu.ops import tp as placer
+    elif kind == "ep":
+        from p2pdl_tpu.ops import moe as placer
+    else:
+        from p2pdl_tpu.ops import pipeline as placer
 
-def _ep_params_spec(cfg: Config):
-    """Per-leaf PartitionSpec tree for expert-parallel params (full logical
-    shapes, expert-stacked leaves split over the ep axis — ``ops.moe``)."""
-    from p2pdl_tpu.ops import moe
-
-    abstract = jax.eval_shape(lambda: init_peer_state(cfg)).params
-    return moe.param_specs(abstract)
-
-
-def _pp_params_spec(cfg: Config):
-    """Per-leaf PartitionSpec tree for pipeline-parallel params (full
-    logical shapes, depth-stacked block leaves split over the pp axis —
-    ``ops.pipeline``)."""
-    from p2pdl_tpu.ops import pipeline
-
-    abstract = jax.eval_shape(lambda: init_peer_state(cfg)).params
-    return pipeline.param_specs(abstract)
+    abstract = jax.eval_shape(lambda: init_peer_state(cfg))
+    params_spec = placer.param_specs(abstract.params)
+    opt_spec = derived_tree_specs(abstract.opt_state, params_spec, PEER_AXIS)
+    return params_spec, opt_spec
 
 
 def make_forward_fn(
@@ -348,18 +345,19 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
             cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis, ep_axis=ep_axis
         )
         params_spec = P()
-    if tp_axis is not None:
-        # Per-leaf placement: column/row kernels split over the tp axis.
-        params_spec = _tp_params_spec(cfg)
-    if ep_axis is not None:
-        # Per-leaf placement: expert-stacked leaves split over the ep axis.
-        params_spec = _ep_params_spec(cfg)
-    if pp_axis is not None:
-        # Per-leaf placement: depth-stacked block leaves split over pp.
-        params_spec = _pp_params_spec(cfg)
-
     sp = P(PEER_AXIS)
     sr = P()
+    opt_spec = sp
+    # Per-leaf placement (params: column/row kernels over tp / expert stacks
+    # over ep / depth stacks over pp; optimizer state mirrors the params —
+    # what makes momentum compose with the sharded axes).
+    if tp_axis is not None:
+        params_spec, opt_spec = _model_parallel_specs(cfg, "tp")
+    elif ep_axis is not None:
+        params_spec, opt_spec = _model_parallel_specs(cfg, "ep")
+    elif pp_axis is not None:
+        params_spec, opt_spec = _model_parallel_specs(cfg, "pp")
+
     # Inputs [P, S, ...]: under sequence parallelism the third dimension
     # (image height for ViT — the stride-aligned patch stem makes row blocks
     # independent) is additionally sharded over the seq axis.
@@ -367,8 +365,8 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
     smapped = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(params_spec, sp, sp, x_spec, sp, sr, sr, sr, sr),
-        out_specs=(params_spec, sp, sp) + ((sp,) if emit_delta else ()),
+        in_specs=(params_spec, opt_spec, sp, x_spec, sp, sr, sr, sr, sr),
+        out_specs=(params_spec, opt_spec, sp) + ((sp,) if emit_delta else ()),
     )
 
     def round_fn(state: PeerState, x, y, trainer_idx, byz_gate, mask_key):
@@ -440,12 +438,15 @@ def build_multi_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Calla
             cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis, ep_axis=ep_axis
         )
         params_spec = P()
+    sp = P(PEER_AXIS)
+    sr = P()
+    opt_spec = sp
     if tp_axis is not None:
-        params_spec = _tp_params_spec(cfg)
-    if ep_axis is not None:
-        params_spec = _ep_params_spec(cfg)
-    if pp_axis is not None:
-        params_spec = _pp_params_spec(cfg)
+        params_spec, opt_spec = _model_parallel_specs(cfg, "tp")
+    elif ep_axis is not None:
+        params_spec, opt_spec = _model_parallel_specs(cfg, "ep")
+    elif pp_axis is not None:
+        params_spec, opt_spec = _model_parallel_specs(cfg, "pp")
 
     def multi_body(params, opt_state, rng, x, y, trainer_mat, byz_gate, round0, base_key):
         def step(carry, inputs):
@@ -465,14 +466,12 @@ def build_multi_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Calla
         )
         return params, opt_state, losses  # losses: [R, L]
 
-    sp = P(PEER_AXIS)
-    sr = P()
     x_spec = P(PEER_AXIS, None, SEQ_AXIS) if seq_axis is not None else sp
     smapped = jax.shard_map(
         multi_body,
         mesh=mesh,
-        in_specs=(params_spec, sp, sp, x_spec, sp, sr, sr, sr, sr),
-        out_specs=(params_spec, sp, P(None, PEER_AXIS)),
+        in_specs=(params_spec, opt_spec, sp, x_spec, sp, sr, sr, sr, sr),
+        out_specs=(params_spec, opt_spec, P(None, PEER_AXIS)),
     )
 
     def multi_round_fn(state: PeerState, x, y, trainer_mat, byz_gate, base_key):

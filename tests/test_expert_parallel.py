@@ -178,11 +178,8 @@ def test_ep_config_validation():
             ep_shards=2, moe_experts=4, model="vit_tiny", dataset="cifar10",
             batch_size=31, samples_per_peer=31,
         )
-    with pytest.raises(ValueError, match="momentum"):
-        Config(
-            ep_shards=2, moe_experts=4, model="vit_tiny", dataset="cifar10",
-            momentum=0.9,
-        )
+    # Momentum composes with ep (optimizer state gets per-leaf placement).
+    Config(ep_shards=2, moe_experts=4, model="vit_tiny", dataset="cifar10", momentum=0.9)
     with pytest.raises(ValueError, match="exclusive"):
         Config(
             ep_shards=2, seq_shards=2, moe_experts=4, model="vit_tiny",

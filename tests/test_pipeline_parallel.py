@@ -140,8 +140,8 @@ def test_pp_config_validation():
         Config(pp_shards=2, model="mlp")
     with pytest.raises(ValueError, match="divide the transformer depth"):
         Config(pp_shards=5, model="vit_tiny", dataset="cifar10")
-    with pytest.raises(ValueError, match="momentum"):
-        Config(pp_shards=2, model="vit_tiny", dataset="cifar10", momentum=0.9)
+    # Momentum composes with pp (optimizer state gets per-leaf placement).
+    Config(pp_shards=2, model="vit_tiny", dataset="cifar10", momentum=0.9)
     with pytest.raises(ValueError, match="exclusive"):
         Config(
             pp_shards=2, seq_shards=2, model="vit_tiny", dataset="cifar10",

@@ -110,11 +110,8 @@ def test_tp_config_validation():
         Config(tp_shards=2, model="mlp")
     with pytest.raises(ValueError, match="head count"):
         Config(tp_shards=2, model="vit_tiny", dataset="cifar10")  # 3 heads
-    with pytest.raises(ValueError, match="momentum"):
-        Config(
-            tp_shards=2, model="vit_tiny", dataset="cifar10",
-            vit_heads=4, momentum=0.9,
-        )
+    # Momentum composes with tp (optimizer state gets per-leaf placement).
+    Config(tp_shards=2, model="vit_tiny", dataset="cifar10", vit_heads=4, momentum=0.9)
     with pytest.raises(ValueError, match="exclusive"):
         Config(
             tp_shards=2, seq_shards=2, model="vit_tiny", dataset="cifar10",
