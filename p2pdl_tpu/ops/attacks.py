@@ -5,8 +5,9 @@ The reference lists Byzantine fault tolerance as TODO (reference
 peers are first-class: a per-peer gate vector selects which peers corrupt
 their update before aggregation, entirely on-device, so robust-aggregation
 configs (Krum / trimmed-mean vs. 10% adversaries) are testable and
-benchmarkable. ``attack`` is a static config string, so each attack compiles
-to a fused elementwise epilogue on the delta.
+benchmarkable. The static corruptions compile to a fused elementwise
+epilogue on the delta; the adaptive "alie" collusion additionally reads
+cross-peer statistics with two psums per leaf.
 """
 
 from __future__ import annotations
@@ -15,8 +16,14 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-ATTACKS = ("none", "sign_flip", "noise", "zero", "scale")
+ATTACKS = ("none", "sign_flip", "noise", "zero", "scale", "alie")
+
+# ALIE perturbation magnitude in honest-update standard deviations. Baruch
+# et al. derive the largest z that keeps attackers inside the acceptance
+# envelope from (n, m); 1.0 is a conservative within-one-sigma choice.
+ALIE_Z = 1.0
 
 
 def apply_attack(
@@ -25,11 +32,20 @@ def apply_attack(
     gate: jnp.ndarray,
     key: jax.Array,
     scale: float = 10.0,
+    axis_name: str | None = None,
 ) -> Any:
     """Corrupt the updates of gated peers.
 
     ``deltas``: pytree with leading local-peer axis ``[L, ...]``;
     ``gate``: ``[L]`` 1.0 for Byzantine peers, 0.0 honest.
+
+    ``"alie"`` (A Little Is Enough, Baruch et al. 2019) is the ADAPTIVE
+    collusion: attackers submit ``mean - z * std`` of the HONEST updates
+    per coordinate — a coordinated pull that hides within the honest
+    spread, where naive magnitude-based defenses see nothing unusual.
+    It needs the honest population statistics, so ``axis_name`` must name
+    the peer mesh axis when called inside ``shard_map`` (local + psum
+    moments); the static corruptions ignore it.
     """
     if attack == "none":
         return deltas
@@ -37,6 +53,37 @@ def apply_attack(
         raise ValueError(f"unknown attack {attack!r}; one of {ATTACKS}")
 
     leaves, treedef = jax.tree.flatten(deltas)
+    if attack == "alie":
+        honest = (1.0 - gate).astype(jnp.float32)
+
+        def total(x):
+            # Whole-tree psums: two collective rounds total, not two per
+            # leaf (each leaf's var psum would otherwise chain on its own
+            # mean psum).
+            return lax.psum(x, axis_name) if axis_name is not None else x
+
+        def h_of(l):
+            return honest.reshape((l.shape[0],) + (1,) * (l.ndim - 1)).astype(l.dtype)
+
+        sums, n_h = total(
+            ([jnp.sum(l * h_of(l), axis=0) for l in leaves], jnp.sum(honest))
+        )
+        n_h = jnp.maximum(n_h, 1.0)
+        means = [s / n_h.astype(s.dtype) for s in sums]
+        sq = total(
+            [
+                jnp.sum((l - m) ** 2 * h_of(l), axis=0)
+                for l, m in zip(leaves, means)
+            ]
+        )
+        out = []
+        for l, mean, s2 in zip(leaves, means, sq):
+            h = h_of(l)
+            var = s2 / n_h.astype(l.dtype)
+            bad = mean - jnp.asarray(ALIE_Z, l.dtype) * jnp.sqrt(var)
+            out.append((1.0 - h) * bad + h * l)
+        return jax.tree.unflatten(treedef, out)
+
     out = []
     for i, l in enumerate(leaves):
         g = gate.reshape((l.shape[0],) + (1,) * (l.ndim - 1)).astype(l.dtype)

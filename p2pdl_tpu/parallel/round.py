@@ -594,7 +594,10 @@ def _gossip_body(cfg, mesh, attack, model, opt, l_per_dev, emit_delta=False):
         )
         delta = jax.tree.map(lambda n, p: n - p, new_params, params)
         gate = byz_gate[local_ids]
-        delta = apply_attack(attack, delta, gate, jax.random.fold_in(mask_key, dev))
+        delta = apply_attack(
+            attack, delta, gate, jax.random.fold_in(mask_key, dev),
+            axis_name=PEER_AXIS,
+        )
         attacked = jax.tree.map(lambda p, d: p + d, params, delta)
         mixed = ring_mix(attacked)
         if emit_delta:
@@ -674,7 +677,10 @@ def _local_train_phase(cfg, attack, model, opt, l_per_dev, seq_axis=None, ep_axi
             losses = lax.psum(losses, ep_axis)
         delta = jax.tree.map(lambda n, p: n - p[None], new_params, pvaried)
         gate = byz_gate[local_ids]
-        delta = apply_attack(attack, delta, gate, jax.random.fold_in(mask_key, dev))
+        delta = apply_attack(
+            attack, delta, gate, jax.random.fold_in(mask_key, dev),
+            axis_name=PEER_AXIS,
+        )
         return delta, new_opt, losses
 
     return phase
@@ -779,6 +785,10 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev):
         raise ValueError(
             f"peer_chunk ({chunk}) must divide peers-per-device ({l_per_dev})"
         )
+    if attack == "alie":
+        # ALIE reads the honest population's moments; a chunk sees only its
+        # own peers, so the streamed body would compute the wrong envelope.
+        raise ValueError("attack='alie' is not supported with peer_chunk")
     n_chunks = l_per_dev // chunk
 
     def body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):

@@ -196,6 +196,51 @@ def test_fedavg_breaks_under_attack_krum_does_not(base_cfg, mesh8):
     assert ev_krum["eval_acc"] > ev_avg["eval_acc"]
 
 
+def test_alie_construction_hits_honest_envelope(mesh8):
+    """Unit level: under the adaptive ALIE collusion, every attacker's
+    update equals mean - z*std of the HONEST updates per coordinate
+    (cross-device statistics via psum), and honest updates pass through
+    untouched."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from p2pdl_tpu.ops.attacks import ALIE_Z, apply_attack
+
+    rng = np.random.default_rng(0)
+    deltas = {"w": jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)}
+    gate = jnp.zeros(16).at[3].set(1.0).at[9].set(1.0)
+
+    def body(d, g):
+        return apply_attack("alie", d, g, jax.random.PRNGKey(0), axis_name="peers")
+
+    attacked = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh8, in_specs=(P("peers"), P("peers")), out_specs=P("peers")
+        )
+    )(deltas, gate)["w"]
+    honest = np.asarray(deltas["w"])[np.asarray(gate) == 0]
+    want_bad = honest.mean(axis=0) - ALIE_Z * honest.std(axis=0)
+    np.testing.assert_allclose(np.asarray(attacked[3]), want_bad, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(attacked[9]), want_bad, atol=1e-5)
+    mask = np.asarray(gate) == 0
+    np.testing.assert_array_equal(
+        np.asarray(attacked)[mask], np.asarray(deltas["w"])[mask]
+    )
+
+
+def test_robust_reducers_under_alie(base_cfg, mesh8):
+    """Integration: the adaptive collusion runs end-to-end through the
+    compiled round; training still progresses under trimmed-mean with the
+    in-envelope perturbation (ALIE is designed to slip past defenses — the
+    assertion is liveness + bounded harm at f=2/8, not immunity)."""
+    cfg = base_cfg.replace(
+        aggregator="trimmed_mean", trimmed_mean_beta=0.25, trainers_per_round=8
+    )
+    _, losses, ev = _run_rounds(cfg, mesh8, n_rounds=4, attack="alie", byz_ids=(1, 5))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(ev["eval_acc"])
+
+
 def test_trimmed_mean_resists_scale_attack(base_cfg, mesh8):
     cfg = base_cfg.replace(aggregator="trimmed_mean", trimmed_mean_beta=0.25)
     _, losses, ev = _run_rounds(cfg, mesh8, n_rounds=4, attack="scale", byz_ids=(2,))
