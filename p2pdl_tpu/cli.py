@@ -37,6 +37,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dirichlet-alpha", type=float, default=0.5)
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--aggregator", choices=AGGREGATORS, default="fedavg")
+    p.add_argument(
+        "--gossip-graph",
+        choices=["ring", "exponential"],
+        default="ring",
+        help="gossip mixing graph: static ±1 ring or round-cycled ±2^k "
+        "exponential strides (O(log P) consensus)",
+    )
     p.add_argument("--trimmed-mean-beta", type=float, default=0.1)
     p.add_argument("--multi-krum-m", type=int, default=0)
     p.add_argument(
@@ -199,6 +206,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         dirichlet_alpha=args.dirichlet_alpha,
         seq_len=args.seq_len,
         aggregator=args.aggregator,
+        gossip_graph=args.gossip_graph,
         trimmed_mean_beta=args.trimmed_mean_beta,
         multi_krum_m=args.multi_krum_m,
         robust_impl=args.robust_impl,

@@ -66,9 +66,13 @@ class Config:
     seq_len: int = 128  # for char_lstm / sequence models
 
     # Aggregation / communication. The exchange topology follows the
-    # aggregator: "gossip" = ring neighbor-mixing, everything else = global
-    # collective (the reference's full-mesh broadcast role).
+    # aggregator: "gossip" = decentralized neighbor-mixing, everything else
+    # = global collective (the reference's full-mesh broadcast role).
     aggregator: str = "fedavg"
+    # Gossip mixing graph: "ring" (static ±1 neighbors; O(P²) rounds to
+    # consensus) or "exponential" (±2^(r mod log₂P) per round; O(log P)
+    # rounds at the same per-round traffic — ops/gossip.py).
+    gossip_graph: str = "ring"
     trimmed_mean_beta: float = 0.1  # fraction trimmed from each tail
     multi_krum_m: int = 0  # 0 => n_trainers - f - 2 selected
     # Robust-reducer execution strategy: "blockwise" streams the peer axis
@@ -166,6 +170,15 @@ class Config:
             raise ValueError(f"unknown dataset {self.dataset!r}; one of {DATASETS}")
         if self.partition not in PARTITIONS:
             raise ValueError(f"unknown partition {self.partition!r}; one of {PARTITIONS}")
+        if self.gossip_graph not in ("ring", "exponential"):
+            raise ValueError(
+                f"unknown gossip_graph {self.gossip_graph!r}; one of "
+                f"('ring', 'exponential')"
+            )
+        if self.gossip_graph != "ring" and self.aggregator != "gossip":
+            raise ValueError(
+                "gossip_graph is only meaningful with aggregator='gossip'"
+            )
         if self.attn_impl not in ("dense", "flash"):
             raise ValueError(
                 f"unknown attn_impl {self.attn_impl!r}; one of ('dense', 'flash')"

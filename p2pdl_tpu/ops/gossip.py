@@ -1,16 +1,27 @@
-"""Ring gossip averaging over the peer axis.
+"""Gossip averaging over the peer axis: ring and exponential graphs.
 
 The reference's only dissemination pattern is full-mesh broadcast over fresh
 TCP connections (reference ``aggregator/aggregation.py:66-77``). The
 decentralized-averaging capability (D-PSGD-style neighbor mixing) is built
-TPU-native instead: peers form a logical ring laid out as
-``n_devices x peers_per_device``; in-device neighbors mix with ``jnp.roll``
-(pure VMEM shuffles) and the two ring edges cross devices with a single
-``lax.ppermute`` each over ICI.
+TPU-native instead: peers form a logical sequence laid out as
+``n_devices x peers_per_device``; neighbor blocks cross devices with
+``lax.ppermute`` over ICI.
+
+Two mixing graphs:
+
+- ``ring_mix``: the static ±1 ring (3-neighbor Metropolis weights) — the
+  classic D-PSGD topology; spectral gap O(1/P²), so consensus needs O(P²)
+  rounds.
+- ``exp_mix``: the one-peer exponential graph — at round r each peer mixes
+  with peers at ±2^(r mod ⌈log₂P⌉); cycling through the log₂P power-of-two
+  strides touches every scale, giving consensus in O(log P) rounds at the
+  same per-round traffic as the ring (Assran et al. 2019 SGP; Ying et al.
+  2021 show the exponential graph is provably efficient).
 """
 
 from __future__ import annotations
 
+import math
 from typing import Any
 
 import jax
@@ -43,3 +54,70 @@ def ring_mix(tree: Any, axis_name: str = PEER_AXIS, self_weight: float = 1.0 / 3
         return self_weight * x + side * (left + right)
 
     return jax.tree.map(leaf, tree)
+
+
+def _global_shift(x: jnp.ndarray, offset: int, axis_name: str) -> jnp.ndarray:
+    """``y[l] = x_global[(global_idx + offset) mod P]`` for a device-major
+    stacked leaf ``[L, ...]`` inside ``shard_map``. ``offset`` is static.
+
+    Rows are sliced BEFORE they cross ICI — exactly L rows move per shift
+    (split k / L-k between the two source devices when the stride straddles
+    a block boundary), the same per-round traffic as the ring."""
+    l_per_dev = x.shape[0]
+    n_dev = lax.axis_size(axis_name)
+    d, k = divmod(offset % (n_dev * l_per_dev), l_per_dev)
+
+    def from_dev_ahead(part, shift):
+        # Receive ``part``'s rows from device (self + shift).
+        if shift % n_dev == 0:
+            return part
+        perm = [(j, (j - shift) % n_dev) for j in range(n_dev)]
+        return lax.ppermute(part, axis_name, perm)
+
+    if k == 0:
+        return from_dev_ahead(x, d)
+    return jnp.concatenate(
+        [from_dev_ahead(x[k:], d), from_dev_ahead(x[:k], d + 1)], axis=0
+    )
+
+
+def exp_mix(
+    tree: Any,
+    round_idx: jnp.ndarray,
+    axis_name: str = PEER_AXIS,
+    self_weight: float = 1.0 / 3.0,
+) -> Any:
+    """One-peer exponential-graph gossip: at round ``r`` mix with the peers
+    at ±2^(r mod ⌈log₂P⌉) — same symmetric 3-neighbor weights as the ring,
+    stride cycling through every power-of-two scale. ``round_idx`` is
+    traced, so the stride is selected by ``lax.switch`` over the (static)
+    log₂P candidate mixes. Doubly stochastic at every stride, so the global
+    mean is preserved exactly and consensus contracts at every round."""
+    leaves, treedef = jax.tree.flatten(tree)
+    l_per_dev = leaves[0].shape[0]
+    # Static axis size: shard_map binds mesh axes at trace time.
+    n_dev = lax.axis_size(axis_name)
+    num_peers = n_dev * l_per_dev
+    n_strides = max(1, math.ceil(math.log2(num_peers)))
+    side = (1.0 - self_weight) / 2.0
+
+    def mix_at(offset):
+        def branch(leaves_in):
+            return [
+                self_weight * x
+                + side
+                * (
+                    _global_shift(x, offset, axis_name)
+                    + _global_shift(x, num_peers - offset, axis_name)
+                )
+                for x in leaves_in
+            ]
+
+        return branch
+
+    mixed = lax.switch(
+        round_idx % n_strides,
+        [mix_at(2**j) for j in range(n_strides)],
+        leaves,
+    )
+    return jax.tree.unflatten(treedef, mixed)

@@ -49,7 +49,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from p2pdl_tpu.config import Config
 from p2pdl_tpu.ops import aggregators, sharded_aggregators
 from p2pdl_tpu.ops.attacks import apply_attack
-from p2pdl_tpu.ops.gossip import ring_mix
+from p2pdl_tpu.ops.gossip import exp_mix, ring_mix
 from p2pdl_tpu.ops.secure_agg import apply_masks
 from p2pdl_tpu.parallel.mesh import (
     EP_AXIS,
@@ -579,10 +579,11 @@ def build_trust_round_fns(cfg: Config, mesh: Mesh, attack: str = "none") -> tupl
 
 def _gossip_body(cfg, mesh, attack, model, opt, l_per_dev, emit_delta=False):
     """Decentralized averaging (D-PSGD): peer-stacked params; every peer
-    trains, then mixes parameters with its ring neighbors — no roles, no
-    global sync. Byzantine peers mix their corrupted params into the ring.
-    With ``emit_delta`` (trust plane on) the per-peer deltas are returned so
-    the host can digest-broadcast them."""
+    trains, then mixes parameters with its graph neighbors (``cfg.
+    gossip_graph``: static ring or round-cycled exponential strides) — no
+    roles, no global sync. Byzantine peers mix their corrupted params into
+    the graph. With ``emit_delta`` (trust plane on) the per-peer deltas are
+    returned so the host can digest-broadcast them."""
     local_train = make_local_train(cfg, model, opt)
 
     def body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
@@ -599,7 +600,11 @@ def _gossip_body(cfg, mesh, attack, model, opt, l_per_dev, emit_delta=False):
             axis_name=PEER_AXIS,
         )
         attacked = jax.tree.map(lambda p, d: p + d, params, delta)
-        mixed = ring_mix(attacked)
+        mixed = (
+            exp_mix(attacked, round_idx)
+            if cfg.gossip_graph == "exponential"
+            else ring_mix(attacked)
+        )
         if emit_delta:
             return mixed, new_opt, losses, delta
         return mixed, new_opt, losses
