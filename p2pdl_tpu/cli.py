@@ -89,6 +89,14 @@ def build_parser() -> argparse.ArgumentParser:
         "sequence over a mesh axis of this size (ring attention); 1=off",
     )
     p.add_argument(
+        "--seq-impl",
+        choices=["ring", "ulysses"],
+        default="ring",
+        help="sequence-parallel attention: ring (blockwise k/v rotation) or "
+        "ulysses (all-to-all heads<->sequence re-shard; needs "
+        "--seq-shards | --vit-heads)",
+    )
+    p.add_argument(
         "--vit-pool",
         choices=["cls", "mean"],
         default="cls",
@@ -220,6 +228,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         remat=args.remat,
         attn_impl=args.attn_impl,
         seq_shards=args.seq_shards,
+        seq_impl=args.seq_impl,
         vit_pool=args.vit_pool,
         vit_heads=args.vit_heads,
         vit_depth=args.vit_depth,

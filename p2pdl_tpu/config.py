@@ -107,10 +107,14 @@ class Config:
     # flash = fused Pallas TPU kernels, ops/pallas_attention.py).
     attn_impl: str = "dense"
     # Sequence/context parallelism: shard each peer's token sequence over a
-    # second mesh axis of this size; attention runs as exact ring attention
-    # (ops/ring_attention.py) over ICI. 1 = off. Requires an attention model
+    # second mesh axis of this size. 1 = off. Requires an attention model
     # (vit_tiny) with vit_pool="mean".
     seq_shards: int = 1
+    # Sequence-parallel attention formulation: "ring" (exact blockwise ring
+    # attention, ops/ring_attention.py — k/v blocks rotate over ICI, any
+    # head count) or "ulysses" (all-to-all heads<->sequence re-shard, full
+    # attention on heads/S local heads — needs seq_shards | vit_heads).
+    seq_impl: str = "ring"
     # ViT head: "cls" token (default) or "mean" pooling (required — and
     # psum-reduced — under sequence parallelism).
     vit_pool: str = "cls"
@@ -292,6 +296,10 @@ class Config:
                 )
         if self.seq_shards < 1:
             raise ValueError(f"seq_shards must be >= 1, got {self.seq_shards}")
+        if self.seq_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"unknown seq_impl {self.seq_impl!r}; one of ('ring', 'ulysses')"
+            )
         if self.seq_shards > 1:
             if self.model != "vit_tiny":
                 raise ValueError(
@@ -302,6 +310,12 @@ class Config:
                 raise ValueError(
                     "seq_shards > 1 requires vit_pool='mean' (a CLS token "
                     "lives on one shard and breaks the uniform block layout)"
+                )
+            if self.seq_impl == "ulysses" and self.vit_heads % self.seq_shards != 0:
+                raise ValueError(
+                    f"seq_impl='ulysses' needs seq_shards ({self.seq_shards}) "
+                    f"to divide vit_heads ({self.vit_heads}) — whole heads "
+                    f"are the unit of the all-to-all re-shard"
                 )
             if self.aggregator == "gossip":
                 raise ValueError("seq_shards > 1 is not supported with gossip")

@@ -33,6 +33,7 @@ class TransformerBlock(nn.Module):
     mlp_ratio: int = 4
     attn_impl: str = "dense"
     seq_axis: str | None = None
+    seq_impl: str = "ring"  # "ring" | "ulysses" (with seq_axis set)
     # Tensor parallelism: heads + MLP hidden sharded over this mesh axis
     # (megatron column/row decomposition; placement in ops/tp.py).
     # tp_shards sizes the declared features to the local slice.
@@ -56,6 +57,7 @@ class TransformerBlock(nn.Module):
             self.heads,
             impl=self.attn_impl,
             seq_axis=self.seq_axis,
+            seq_impl=self.seq_impl,
             tp_axis=self.tp_axis,
             tp_shards=self.tp_shards,
         )(y)
@@ -92,6 +94,7 @@ class ViTTiny(nn.Module):
     attn_impl: str = "dense"  # "flash" fuses attention via Pallas on TPU
     pool: str = "cls"  # "cls" | "mean"
     seq_axis: str | None = None  # mesh axis the token sequence is sharded on
+    seq_impl: str = "ring"  # "ring" | "ulysses" (with seq_axis set)
     tp_axis: str | None = None  # mesh axis heads/MLP-hidden are sharded on
     tp_shards: int = 1
     # Mixture-of-experts: every ``moe_every``-th block (1-based from block
@@ -180,6 +183,7 @@ class ViTTiny(nn.Module):
                     self.heads,
                     attn_impl=self.attn_impl,
                     seq_axis=self.seq_axis,
+                    seq_impl=self.seq_impl,
                     tp_axis=self.tp_axis,
                     tp_shards=self.tp_shards,
                     moe_experts=self.moe_experts if is_moe else 0,

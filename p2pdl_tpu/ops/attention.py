@@ -39,15 +39,27 @@ class MultiHeadAttention(nn.Module):
     """MHA over [B, T, dim].
 
     With ``seq_axis`` set (the name of a mesh axis the sequence is sharded
-    over, inside ``shard_map``), attention runs as exact blockwise ring
-    attention (``p2pdl_tpu.ops.ring_attention``) — T here is the *local*
-    block and k/v blocks rotate over ICI. Otherwise dense single-device SDPA.
+    over, inside ``shard_map``), attention runs sequence-parallel in one of
+    two exact formulations selected by ``seq_impl``:
+
+    - ``"ring"``: blockwise ring attention (``p2pdl_tpu.ops.ring_attention``)
+      — T here is the *local* block and k/v blocks rotate over ICI with an
+      online-softmax merge. Communication: (S-1) rotations of the local k/v
+      block per layer; any head count.
+    - ``"ulysses"``: the all-to-all formulation (DeepSpeed-Ulysses) — one
+      ``all_to_all`` re-shards heads<->sequence so each shard computes
+      FULL-length attention for ``heads / S`` heads (dense or fused flash,
+      unchanged), then one ``all_to_all`` back. Communication: 2
+      all_to_alls of the activations per layer; requires ``S | heads``.
+
+    Otherwise dense single-device SDPA.
     """
 
     dim: int
     heads: int
     causal: bool = False
     seq_axis: str | None = None
+    seq_impl: str = "ring"  # "ring" | "ulysses" (with seq_axis set)
     impl: str = "dense"  # "dense" | "flash" (fused Pallas kernels)
     # Tensor parallelism: mesh axis the heads are sharded over (inside
     # shard_map with this module's qkv kernel column-sharded and the output
@@ -79,7 +91,29 @@ class MultiHeadAttention(nn.Module):
         q, k, v = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))  # [B, H, T, D]
         if self.impl not in ("dense", "flash"):
             raise ValueError(f"unknown attention impl {self.impl!r}; one of ('dense', 'flash')")
-        if self.seq_axis is not None:
+        if self.seq_axis is not None and self.seq_impl == "ulysses":
+            n_shards = jax.lax.axis_size(self.seq_axis)
+            if local_heads % n_shards != 0:
+                raise ValueError(
+                    f"ulysses sequence parallelism needs the shard count "
+                    f"({n_shards}) to divide the head count ({local_heads})"
+                )
+            # Re-shard heads<->sequence: [B, H, T_local, D] -> [B, H/S,
+            # T_global, D] (concat over source shards = device-major
+            # sequence order), run UNSHARDED attention on the local heads,
+            # then the inverse exchange.
+            a2a = lambda x, s, c: jax.lax.all_to_all(  # noqa: E731
+                x, self.seq_axis, split_axis=s, concat_axis=c, tiled=True
+            )
+            q, k, v = (a2a(a, 1, 2) for a in (q, k, v))
+            if self.impl == "flash":
+                from p2pdl_tpu.ops.pallas_attention import flash_attention
+
+                out = flash_attention(q, k, v, causal=self.causal)
+            else:
+                out = sdpa(q, k, v, causal=self.causal)
+            out = a2a(out, 2, 1)
+        elif self.seq_axis is not None:
             from p2pdl_tpu.ops.ring_attention import ring_attention
 
             # impl selects the per-block compute inside the ring: "flash"

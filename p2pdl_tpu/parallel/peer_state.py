@@ -102,6 +102,7 @@ def build_model(
             kwargs["moe_capacity_factor"] = cfg.moe_capacity_factor
         if seq_axis is not None:
             kwargs["seq_axis"] = seq_axis
+            kwargs["seq_impl"] = cfg.seq_impl
         if tp_axis is not None:
             kwargs["tp_axis"] = tp_axis
             kwargs["tp_shards"] = cfg.tp_shards
