@@ -422,8 +422,12 @@ def run_matrix(timed_rounds: int = 10) -> list[dict]:
 
     # Fused multi-round mode (R rounds per dispatch): how much of the
     # small-config round time was host dispatch.
-    for fused in (16,):
-        entry = matrix_entries()[0]  # mnist_mlp_8peers_fedavg
+    # The two most dispatch-bound configs: the tiny MLP round and the
+    # 256-peer gossip ring (no role sampling between rounds to stop for).
+    entries = matrix_entries()
+    fused_names = ("mnist_mlp_8peers_fedavg", "shakespeare_lstm_256peers_gossip")
+    for entry in (e for e in entries if e["name"] in fused_names):
+        fused = 16
         name = f"agg_rounds_per_sec_{entry['name']}_fused{fused}"
         value, err = _with_retry(
             lambda e=entry, f=fused: bench_config(

@@ -224,3 +224,50 @@ def test_profiler_phase_stats():
     s = p.summary()
     assert s["round"]["count"] == 3
     assert s["round"]["per_sec"] > 0
+
+
+def test_resume_matches_uninterrupted_model_parallel_momentum(tmp_path):
+    """Resume determinism on a 2-D (peers x tp) mesh WITH momentum: the
+    restored optimizer trace must land back on its per-leaf placement
+    (peer axis + the param's tp spec) and the resumed trajectory must equal
+    the uninterrupted one — params, traces, losses, and roles alike."""
+    cfg = Config(
+        num_peers=4,
+        trainers_per_round=2,
+        rounds=4,
+        local_epochs=1,
+        samples_per_peer=8,
+        batch_size=4,
+        model="vit_tiny",
+        dataset="cifar10",
+        vit_depth=2,
+        vit_heads=4,
+        tp_shards=2,
+        momentum=0.9,
+        compute_dtype="float32",
+    )
+    full = Experiment(cfg, n_devices=8)
+    full_records = full.run()
+
+    ckdir = str(tmp_path / "ckpt")
+    first = Experiment(cfg, n_devices=8, checkpoint_dir=ckdir)
+    first.run_round()
+    first.run_round()
+    resumed = Experiment(cfg, n_devices=8, checkpoint_dir=ckdir)
+    assert int(resumed.state.round_idx) == 2
+    resumed_records = resumed.run()
+
+    # Per-round trajectory, not just the endpoint: same roles, same losses.
+    for a, b in zip(full_records[2:], resumed_records):
+        assert a.trainers == b.trainers
+        assert np.isclose(a.train_loss, b.train_loss, rtol=1e-6)
+    assert _trees_equal(full.state.params, resumed.state.params)
+    assert _trees_equal(full.state.opt_state, resumed.state.opt_state)
+    # The restored momentum trace must be ON its per-leaf placement (peer
+    # axis + the param's tp spec), not silently resharded to replicated.
+    tp_sharded = [
+        leaf
+        for leaf in jax.tree.leaves(resumed.state.opt_state)
+        if hasattr(leaf, "sharding") and "tp" in getattr(leaf.sharding, "spec", ())
+    ]
+    assert tp_sharded, "no optimizer leaf carries the tp axis after resume"
