@@ -32,7 +32,6 @@ order and any peer-local stochasticity.
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any
 
 import flax

@@ -14,7 +14,6 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
 
 from p2pdl_tpu.config import Config
 from p2pdl_tpu.runtime.cluster import Cluster
