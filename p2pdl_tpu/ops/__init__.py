@@ -2,7 +2,13 @@
 streamed), gossip, secure masking, attention (dense / fused Pallas / ring),
 tensor-parallel placement, mixture-of-experts dispatch."""
 
+# moe first: its parallel.mesh import runs the parallel package __init__,
+# which (via parallel.round) completes p2pdl_tpu.ops.gossip as a fresh
+# import — importing gossip directly at this point instead would leave it
+# partially initialized when round asks for it (circular-import order).
 from p2pdl_tpu.ops.moe import MoEFFN, top1_route
+from p2pdl_tpu.ops.gossip import exp_mix, ring_mix
+from p2pdl_tpu.ops.pipeline import PipelinedBlocks
 from p2pdl_tpu.ops.aggregators import (
     fedavg,
     krum,
@@ -35,4 +41,7 @@ __all__ = [
     "trimmed_mean_sharded",
     "MoEFFN",
     "top1_route",
+    "PipelinedBlocks",
+    "exp_mix",
+    "ring_mix",
 ]

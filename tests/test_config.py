@@ -46,3 +46,25 @@ def test_derived_properties():
     cfg = Config(num_peers=8, trainers_per_round=3, samples_per_peer=100, batch_size=32)
     assert cfg.testers_per_round == 5
     assert cfg.batches_per_epoch == 3
+
+
+def test_package_import_orders():
+    """Both package entry orders must import cleanly: ops<->parallel have a
+    real dependency cycle (parallel.round uses ops kernels; ops re-exports
+    modules that import parallel.mesh), kept workable by import ordering in
+    ops/__init__ — a regression here only shows up on FIRST import, so each
+    order gets a fresh interpreter."""
+    import subprocess
+    import sys
+
+    for first in ("p2pdl_tpu.ops", "p2pdl_tpu.parallel"):
+        code = (
+            "import jax; jax.config.update('jax_platforms', 'cpu');"
+            f"import {first};"
+            "import p2pdl_tpu.ops, p2pdl_tpu.parallel;"
+            "assert hasattr(p2pdl_tpu.ops, 'exp_mix')"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, timeout=300
+        )
+        assert r.returncode == 0, f"{first} first: {r.stderr[-800:]}"
