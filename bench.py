@@ -32,9 +32,56 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import sys
 import time
 import traceback
+
+# EARLY health gate, before any jax import: a wedged TPU tunnel (observed
+# after any process dies mid-TPU-work) makes `import jax` ITSELF hang in
+# this image — the axon sitecustomize blocks at plugin registration — so
+# the in-module probe below would never be reached. Probing from a killable
+# subprocess first lets a wedged run emit a structured record and exit
+# instead of hanging the caller. Module imports (tests) skip this.
+if __name__ == "__main__" and not os.environ.get("P2PDL_BENCH_SKIP_PROBE"):
+    import subprocess as _subprocess
+
+    _probe = (
+        "import jax, jax.numpy as jnp;"
+        "jnp.sum(jnp.ones((128,128)) @ jnp.ones((128,128))).block_until_ready();"
+        "print('bench-probe-ok')"
+    )
+    _alive = False
+    for _i in range(3):
+        try:
+            _r = _subprocess.run(
+                [sys.executable, "-c", _probe],
+                capture_output=True,
+                timeout=180,
+                text=True,
+            )
+            if "bench-probe-ok" in _r.stdout:
+                _alive = True
+                break
+            print(f"[bench] early probe failed: {_r.stderr[-200:]}", file=sys.stderr)
+        except _subprocess.TimeoutExpired:
+            print("[bench] early probe hung >180s (wedged tunnel?)", file=sys.stderr)
+        time.sleep(60)
+    if not _alive:
+        print(
+            json.dumps(
+                {
+                    "metric": "agg_rounds_per_sec_1024peers_mlp",
+                    "value": 0.0,
+                    "unit": "rounds/sec",
+                    "vs_baseline": 0.0,
+                    "error": "device backend unreachable (early probe: jax "
+                    "import/compute hung in 3 subprocess attempts)",
+                }
+            ),
+            flush=True,
+        )
+        sys.exit(0)
 
 import jax
 import jax.numpy as jnp
