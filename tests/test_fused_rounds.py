@@ -36,14 +36,24 @@ CFG = Config(
 
 
 # The peer_chunk case pins that the chunked-streaming body composes with
-# fused execution (local_epochs > 1 momentum-free config, 2 peers/device).
+# fused execution (local_epochs > 1 momentum-free config, 2 peers/device);
+# the exponential-gossip case pins the round-indexed stride switch inside
+# the fused lax.scan (round0 + r must select each round's stride).
 @pytest.mark.parametrize(
-    "aggregator,peer_chunk,num_peers",
-    [("fedavg", 0, 8), ("gossip", 0, 8), ("fedavg", 2, 16)],
+    "aggregator,peer_chunk,num_peers,gossip_graph",
+    [
+        ("fedavg", 0, 8, "ring"),
+        ("gossip", 0, 8, "ring"),
+        ("gossip", 0, 16, "exponential"),
+        ("fedavg", 2, 16, "ring"),
+    ],
 )
-def test_fused_equals_sequential(mesh8, aggregator, peer_chunk, num_peers):
+def test_fused_equals_sequential(mesh8, aggregator, peer_chunk, num_peers, gossip_graph):
     cfg = CFG.replace(
-        aggregator=aggregator, peer_chunk=peer_chunk, num_peers=num_peers
+        aggregator=aggregator,
+        peer_chunk=peer_chunk,
+        num_peers=num_peers,
+        gossip_graph=gossip_graph if aggregator == "gossip" else "ring",
     )
     data = make_federated_data(cfg, eval_samples=16)
     sh = peer_sharding(mesh8)
