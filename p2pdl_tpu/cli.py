@@ -30,6 +30,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--samples-per-peer", type=int, default=512)
     p.add_argument("--lr", type=float, default=0.01)
     p.add_argument("--momentum", type=float, default=0.0)
+    p.add_argument(
+        "--optimizer",
+        choices=["sgd", "adam"],
+        default="sgd",
+        help="local optimizer (per-peer state persists across rounds)",
+    )
     p.add_argument("--server-lr", type=float, default=0.1)
     p.add_argument("--model", choices=MODELS, default="mlp")
     p.add_argument("--dataset", choices=DATASETS, default="mnist")
@@ -207,6 +213,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         samples_per_peer=args.samples_per_peer,
         lr=args.lr,
         momentum=args.momentum,
+        optimizer=args.optimizer,
         server_lr=args.server_lr,
         model=args.model,
         dataset=args.dataset,

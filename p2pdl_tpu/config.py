@@ -55,6 +55,11 @@ class Config:
     batch_size: int = 32
     lr: float = 0.01
     momentum: float = 0.0
+    # Local optimizer: "sgd" (the reference's choice, node/node.py:30; plus
+    # optional momentum) or "adam" (optax defaults b1=0.9, b2=0.999). The
+    # per-peer optimizer state — momentum trace, or Adam's count/mu/nu —
+    # persists across rounds and advances only for sampled trainers.
+    optimizer: str = "sgd"
     server_lr: float = 0.1
 
     # Model / data.
@@ -174,6 +179,15 @@ class Config:
             raise ValueError(f"unknown dataset {self.dataset!r}; one of {DATASETS}")
         if self.partition not in PARTITIONS:
             raise ValueError(f"unknown partition {self.partition!r}; one of {PARTITIONS}")
+        if self.optimizer not in ("sgd", "adam"):
+            raise ValueError(
+                f"unknown optimizer {self.optimizer!r}; one of ('sgd', 'adam')"
+            )
+        if self.optimizer == "adam" and self.momentum != 0.0:
+            raise ValueError(
+                "momentum is an SGD knob; adam has its own betas "
+                "(set momentum=0.0 with optimizer='adam')"
+            )
         if self.gossip_graph not in ("ring", "exponential"):
             raise ValueError(
                 f"unknown gossip_graph {self.gossip_graph!r}; one of "
@@ -345,10 +359,11 @@ class Config:
                     "axes (seq/tp/ep/pp) yet — the chunked body trains "
                     "each peer on the plain 1-D peer mesh"
                 )
-            if self.momentum != 0.0:
+            if self.momentum != 0.0 or self.optimizer != "sgd":
                 raise ValueError(
-                    "peer_chunk requires momentum=0.0 (per-peer optimizer "
-                    "state does not stream through the chunk scan)"
+                    "peer_chunk requires plain SGD (momentum=0.0, "
+                    "optimizer='sgd') — per-peer optimizer state does not "
+                    "stream through the chunk scan"
                 )
             if self.brb_enabled:
                 raise ValueError(

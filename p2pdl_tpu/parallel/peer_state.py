@@ -65,7 +65,10 @@ def params_layout(cfg: Config) -> str:
 
 
 def make_optimizer(cfg: Config) -> optax.GradientTransformation:
-    """Local-SGD optimizer (reference uses SGD lr=0.01, ``node/node.py:30``)."""
+    """Local optimizer (reference hard-codes SGD lr=0.01, ``node/node.py:30``;
+    we add momentum and Adam as config knobs)."""
+    if cfg.optimizer == "adam":
+        return optax.adam(cfg.lr)
     if cfg.momentum > 0.0:
         return optax.sgd(cfg.lr, momentum=cfg.momentum)
     return optax.sgd(cfg.lr)

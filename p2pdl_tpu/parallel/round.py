@@ -292,6 +292,7 @@ def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
         and cfg.tp_shards == 1
         and cfg.ep_shards == 1
         and cfg.pp_shards == 1
+        and cfg.optimizer == "sgd"
         and cfg.momentum == 0.0
         and cfg.local_epochs == 1
         and cfg.batches_per_epoch == 1

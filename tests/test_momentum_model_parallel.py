@@ -45,11 +45,21 @@ _BASE = dict(
         {"tp_shards": 2, "vit_heads": 4},
         {"ep_shards": 2, "moe_experts": 4, "moe_capacity_factor": 4.0},
         {"pp_shards": 2, "vit_scan_blocks": True},
+        # Adam: count/mu/nu state through the per-leaf placement (mu/nu
+        # mirror the params; the stacked count falls back to P(peers)).
+        {"tp_shards": 2, "vit_heads": 4, "optimizer": "adam", "momentum": 0.0},
     ],
-    ids=["tp", "ep", "pp"],
+    ids=["tp", "ep", "pp", "tp-adam"],
 )
 def test_momentum_rounds_match_dense(mesh8, knobs):
-    base = Config(**_BASE, **{k: v for k, v in knobs.items() if k != "_"})
+    base = Config(**{**_BASE, **knobs})
+    # Two rounds so round 2 consumes round 1's optimizer state — except
+    # adam, where round-2 feedback through the sign-sensitive normalization
+    # turns isolated near-zero-gradient flips into broad small divergence
+    # that no tight cross-layout bound survives; its single round still
+    # exercises state creation + placement, and the sgd-momentum cases
+    # prove the multi-round state plumbing.
+    n_rounds_run = 1 if knobs.get("optimizer") == "adam" else 2
     results = {}
     for sharded in (False, True):
         if sharded:
@@ -68,7 +78,7 @@ def test_momentum_rounds_match_dense(mesh8, knobs):
         x = jax.device_put(data.x, data_sharding(mesh))
         y = jax.device_put(data.y, peer_sharding(mesh))
         fn = build_round_fn(cfg, mesh)
-        for r in range(2):  # round 2 consumes round 1's momentum trace
+        for r in range(n_rounds_run):
             state, m = fn(
                 state, x, y, jnp.asarray([0, 2], jnp.int32), jnp.zeros(4),
                 jax.random.PRNGKey(r),
@@ -77,13 +87,35 @@ def test_momentum_rounds_match_dense(mesh8, knobs):
             jax.tree.map(np.asarray, state.params),
             jax.tree.map(np.asarray, state.opt_state),
         )
-    for which in (0, 1):  # params, then momentum traces
+    # SGD(+momentum) updates are LINEAR in the gradients, so the sharded
+    # layout matches the dense twin to float noise. Adam divides by
+    # sqrt(nu) + eps (eps = 1e-8): on a near-zero-gradient coordinate that
+    # amplifies reduction-order float noise up to a full SIGN FLIP of the
+    # ~lr-sized step (verified: the raw gradients agree to ~1e-6 relative
+    # across layouts), so adam gets the mechanism's bound instead of
+    # exactness: almost every coordinate tight, the violating fraction
+    # tiny, and no deviation beyond the per-step update magnitude.
+    adam = knobs.get("optimizer") == "adam"
+    step_bound = 2 * n_rounds_run * base.lr  # n rounds x (+lr vs -lr flip)
+    loose_count, total_count = 0, 0
+    for which in (0, 1):  # params, then optimizer state
         dense = dict(
             (jax.tree_util.keystr(p), l)
             for p, l in jax.tree_util.tree_leaves_with_path(results[False][which])
         )
         for path, leaf in jax.tree_util.tree_leaves_with_path(results[True][which]):
-            np.testing.assert_allclose(
-                leaf, dense[jax.tree_util.keystr(path)], atol=3e-5,
-                err_msg=f"{'params' if which == 0 else 'opt'}:{jax.tree_util.keystr(path)}",
-            )
+            k = jax.tree_util.keystr(path)
+            label = f"{'params' if which == 0 else 'opt'}:{k}"
+            if not adam:
+                np.testing.assert_allclose(leaf, dense[k], atol=3e-5, err_msg=label)
+                continue
+            diff = np.abs(np.asarray(leaf, np.float64) - np.asarray(dense[k], np.float64))
+            assert float(diff.max(initial=0.0)) <= step_bound, (label, diff.max())
+            if which == 0:
+                loose_count += int(np.sum(diff > 3e-4))
+                total_count += diff.size
+    if adam:
+        # Globally, only isolated coordinates (the near-zero-gradient ones
+        # where adam amplifies float noise into a flipped step) may exceed
+        # the tight tolerance.
+        assert loose_count / total_count < 1e-2, (loose_count, total_count)

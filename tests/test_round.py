@@ -196,6 +196,23 @@ def test_fedavg_breaks_under_attack_krum_does_not(base_cfg, mesh8):
     assert ev_krum["eval_acc"] > ev_avg["eval_acc"]
 
 
+def test_adam_fedavg_learns(base_cfg, mesh8):
+    """optimizer='adam': per-peer count/mu/nu persist across rounds and the
+    federated round still learns (reference hard-codes SGD)."""
+    cfg = base_cfg.replace(optimizer="adam", lr=0.005)
+    _, losses, ev = _run_rounds(cfg, mesh8, n_rounds=4)
+    assert losses[-1] < losses[0]
+    assert ev["eval_acc"] > 0.4
+
+
+def test_optimizer_config_validation():
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        Config(optimizer="rmsprop")
+    with pytest.raises(ValueError, match="momentum is an SGD knob"):
+        Config(optimizer="adam", momentum=0.9)
+    Config(optimizer="adam")
+
+
 def test_alie_construction_hits_honest_envelope(mesh8):
     """Unit level: under the adaptive ALIE collusion, every attacker's
     update equals mean - z*std of the HONEST updates per coordinate
