@@ -36,6 +36,12 @@ def build_parser() -> argparse.ArgumentParser:
         default="sgd",
         help="local optimizer (per-peer state persists across rounds)",
     )
+    p.add_argument(
+        "--weight-decay",
+        type=float,
+        default=0.0,
+        help="L2 into the sgd update / decoupled AdamW for adam; 0=off",
+    )
     p.add_argument("--server-lr", type=float, default=0.1)
     p.add_argument("--model", choices=MODELS, default="mlp")
     p.add_argument("--dataset", choices=DATASETS, default="mnist")
@@ -214,6 +220,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         lr=args.lr,
         momentum=args.momentum,
         optimizer=args.optimizer,
+        weight_decay=args.weight_decay,
         server_lr=args.server_lr,
         model=args.model,
         dataset=args.dataset,

@@ -60,6 +60,9 @@ class Config:
     # per-peer optimizer state — momentum trace, or Adam's count/mu/nu —
     # persists across rounds and advances only for sampled trainers.
     optimizer: str = "sgd"
+    # L2-into-the-update for sgd (grad + wd * p before the momentum);
+    # decoupled AdamW for adam. 0 = off.
+    weight_decay: float = 0.0
     server_lr: float = 0.1
 
     # Model / data.
@@ -188,6 +191,8 @@ class Config:
                 "momentum is an SGD knob; adam has its own betas "
                 "(set momentum=0.0 with optimizer='adam')"
             )
+        if self.weight_decay < 0:
+            raise ValueError(f"weight_decay must be >= 0, got {self.weight_decay}")
         if self.gossip_graph not in ("ring", "exponential"):
             raise ValueError(
                 f"unknown gossip_graph {self.gossip_graph!r}; one of "

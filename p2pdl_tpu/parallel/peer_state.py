@@ -66,12 +66,20 @@ def params_layout(cfg: Config) -> str:
 
 def make_optimizer(cfg: Config) -> optax.GradientTransformation:
     """Local optimizer (reference hard-codes SGD lr=0.01, ``node/node.py:30``;
-    we add momentum and Adam as config knobs)."""
+    we add momentum, Adam, and weight decay as config knobs)."""
     if cfg.optimizer == "adam":
+        if cfg.weight_decay > 0.0:
+            return optax.adamw(cfg.lr, weight_decay=cfg.weight_decay)
         return optax.adam(cfg.lr)
-    if cfg.momentum > 0.0:
-        return optax.sgd(cfg.lr, momentum=cfg.momentum)
-    return optax.sgd(cfg.lr)
+    sgd = (
+        optax.sgd(cfg.lr, momentum=cfg.momentum)
+        if cfg.momentum > 0.0
+        else optax.sgd(cfg.lr)
+    )
+    if cfg.weight_decay > 0.0:
+        # L2 into the update: grad + wd * p, before any momentum.
+        return optax.chain(optax.add_decayed_weights(cfg.weight_decay), sgd)
+    return sgd
 
 
 def build_model(

@@ -294,6 +294,7 @@ def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
         and cfg.pp_shards == 1
         and cfg.optimizer == "sgd"
         and cfg.momentum == 0.0
+        and cfg.weight_decay == 0.0
         and cfg.local_epochs == 1
         and cfg.batches_per_epoch == 1
         and cfg.samples_per_peer == cfg.batch_size

@@ -210,7 +210,30 @@ def test_optimizer_config_validation():
         Config(optimizer="rmsprop")
     with pytest.raises(ValueError, match="momentum is an SGD knob"):
         Config(optimizer="adam", momentum=0.9)
+    with pytest.raises(ValueError, match="weight_decay"):
+        Config(weight_decay=-0.1)
     Config(optimizer="adam")
+
+
+def test_weight_decay_shrinks_weights(base_cfg, mesh8):
+    """weight_decay pulls parameters toward zero: after identical rounds the
+    decayed run has strictly smaller weight norm, and it routes off the
+    pooled-gradient fast path (which knows nothing of decay)."""
+    from p2pdl_tpu.parallel.round import _use_fast_sync_path
+
+    fast_shape = base_cfg.replace(local_epochs=1, samples_per_peer=32)
+    assert _use_fast_sync_path(fast_shape, "none")  # eligible without decay...
+    assert not _use_fast_sync_path(fast_shape.replace(weight_decay=0.1), "none")
+    norms = {}
+    for wd in (0.0, 0.1):
+        state, losses, _ = _run_rounds(
+            base_cfg.replace(weight_decay=wd), mesh8, n_rounds=3
+        )
+        norms[wd] = sum(
+            float(jnp.sum(l.astype(jnp.float32) ** 2)) for l in jax.tree.leaves(state.params)
+        )
+        assert losses[-1] < losses[0]
+    assert norms[0.1] < norms[0.0]
 
 
 def test_alie_construction_hits_honest_envelope(mesh8):
