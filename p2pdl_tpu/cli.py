@@ -66,6 +66,14 @@ def build_parser() -> argparse.ArgumentParser:
         "k = k-regular ring graph (Bell et al.; scales to 1024+ trainers)",
     )
     p.add_argument(
+        "--secure-agg-keys",
+        choices=("ecdh", "shared"),
+        default="ecdh",
+        help="secure_fedavg mask PRF keys: ecdh = pairwise ECDH(P-256)+HKDF "
+        "seeds, Shamir-recoverable on dropout; shared = legacy shared "
+        "experiment key (A/B benchmarking only)",
+    )
+    p.add_argument(
         "--peer-chunk",
         type=int,
         default=0,
@@ -233,6 +241,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         multi_krum_m=args.multi_krum_m,
         robust_impl=args.robust_impl,
         secure_agg_neighbors=args.secure_agg_neighbors,
+        secure_agg_keys=args.secure_agg_keys,
         peer_chunk=args.peer_chunk,
         brb_enabled=args.brb,
         round_timeout_s=args.round_timeout_s,

@@ -94,6 +94,12 @@ class Config:
     # k-regular ring graph (Bell et al. 2020; O(T x k x model), scales to
     # 1024+ trainers; privacy holds unless all k neighbors collude).
     secure_agg_neighbors: int = 0
+    # secure_fedavg mask PRF keys: "ecdh" (default) derives pairwise seeds
+    # by ECDH over per-peer P-256 keypairs + HKDF (protocol/secure_keys) —
+    # underivable from public state, Shamir-recoverable on dropout;
+    # "shared" is the round-3 shared-experiment-key derivation, kept only
+    # for A/B benchmarking the key plumbing's cost.
+    secure_agg_keys: str = "ecdh"
     # Stream the vmapped peer stack through chunks of this size, fusing the
     # masked-sum aggregation into the scan: peak transient HBM becomes
     # O(peer_chunk x model) instead of O(peers_per_device x model) — how
@@ -386,6 +392,10 @@ class Config:
             raise ValueError(
                 f"secure_agg_neighbors must be even (k/2 ring partners per "
                 f"side), got {self.secure_agg_neighbors}"
+            )
+        if self.secure_agg_keys not in ("ecdh", "shared"):
+            raise ValueError(
+                f"unknown secure_agg_keys {self.secure_agg_keys!r}; one of ('ecdh', 'shared')"
             )
         if self.robust_impl not in ("blockwise", "gathered"):
             raise ValueError(

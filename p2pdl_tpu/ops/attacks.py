@@ -33,6 +33,7 @@ def apply_attack(
     key: jax.Array,
     scale: float = 10.0,
     axis_name: str | None = None,
+    peer_ids: jnp.ndarray | None = None,
 ) -> Any:
     """Corrupt the updates of gated peers.
 
@@ -46,6 +47,13 @@ def apply_attack(
     It needs the honest population statistics, so ``axis_name`` must name
     the peer mesh axis when called inside ``shard_map`` (local + psum
     moments); the static corruptions ignore it.
+
+    ``peer_ids``: ``[L]`` GLOBAL peer ids of the stacked rows. The "noise"
+    attack folds them into its draw keys, making the draws a function of
+    (round key, global peer id, leaf) alone — identical across every
+    execution layout (vmap width, peer_chunk, device count), so chunked ==
+    unchunked holds exactly for every attack, not just the deterministic
+    ones. Without ids it falls back to one draw per leaf (layout-coupled).
     """
     if attack == "none":
         return deltas
@@ -95,6 +103,13 @@ def apply_attack(
             bad = scale * l
         else:  # noise
             k = jax.random.fold_in(key, i)
-            bad = scale * jax.random.normal(k, l.shape, l.dtype)
+            if peer_ids is not None:
+                bad = scale * jax.vmap(
+                    lambda pid: jax.random.normal(
+                        jax.random.fold_in(k, pid), l.shape[1:], l.dtype
+                    )
+                )(peer_ids)
+            else:
+                bad = scale * jax.random.normal(k, l.shape, l.dtype)
         out.append(g * bad + (1 - g) * l)
     return jax.tree.unflatten(treedef, out)

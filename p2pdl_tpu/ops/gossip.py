@@ -31,27 +31,59 @@ from jax import lax
 from p2pdl_tpu.parallel.mesh import PEER_AXIS
 
 
-def ring_mix(tree: Any, axis_name: str = PEER_AXIS, self_weight: float = 1.0 / 3.0) -> Any:
+def ring_mix(
+    tree: Any,
+    axis_name: str = PEER_AXIS,
+    self_weight: float = 1.0 / 3.0,
+    mask: jnp.ndarray | None = None,
+) -> Any:
     """Symmetric ring gossip: ``new_i = w*x_i + (1-w)/2 * (x_{i-1} + x_{i+1})``.
 
     Leaves are local blocks ``[L, ...]`` inside ``shard_map``; global peer
     order is device-major. With ``self_weight=1/3`` this is the uniform
     3-neighbor Metropolis mix; row-stochastic and symmetric, so gossip
     converges to the true average over rounds.
+
+    ``mask``: optional ``[L]`` trust verdict (1.0 = verified) — the BRB
+    in-round gate. An unverified neighbor's params contribute ZERO to every
+    other peer's mix and its weight mass reverts to self
+    (``w_ii = self_weight + side * ((1 - m_left) + (1 - m_right))``), so
+    rows stay stochastic and, with every mask 1, the weights equal the
+    unmasked mix exactly (values match up to float add association). This
+    is the reference's never-consume-unverified semantic (reference
+    ``node/node.py:130-145``) for the in-band mix.
     """
     n_dev = lax.axis_size(axis_name)
     fwd = [(i, (i + 1) % n_dev) for i in range(n_dev)]
     bwd = [(i, (i - 1) % n_dev) for i in range(n_dev)]
     side = (1.0 - self_weight) / 2.0
 
-    def leaf(x):
+    def shifted(x):
         # x: [L, ...]. Left neighbor of local peer 0 lives on the previous
         # device (its last peer); right neighbor of local peer L-1 on the next.
         from_prev = lax.ppermute(x[-1:], axis_name, fwd)  # prev device's tail
         from_next = lax.ppermute(x[:1], axis_name, bwd)  # next device's head
         left = jnp.concatenate([from_prev, x[:-1]], axis=0)
         right = jnp.concatenate([x[1:], from_next], axis=0)
-        return self_weight * x + side * (left + right)
+        return left, right
+
+    if mask is None:
+        def leaf(x):
+            left, right = shifted(x)
+            return self_weight * x + side * (left + right)
+
+        return jax.tree.map(leaf, tree)
+
+    m = mask.astype(jnp.float32)
+    ml, mr = shifted(m)
+
+    def leaf(x):
+        left, right = shifted(x)
+        bshape = (x.shape[0],) + (1,) * (x.ndim - 1)
+        wl = (side * ml).reshape(bshape).astype(x.dtype)
+        wr = (side * mr).reshape(bshape).astype(x.dtype)
+        ws = (self_weight + side * ((1.0 - ml) + (1.0 - mr))).reshape(bshape).astype(x.dtype)
+        return ws * x + wl * left + wr * right
 
     return jax.tree.map(leaf, tree)
 
@@ -86,13 +118,19 @@ def exp_mix(
     round_idx: jnp.ndarray,
     axis_name: str = PEER_AXIS,
     self_weight: float = 1.0 / 3.0,
+    mask: jnp.ndarray | None = None,
 ) -> Any:
     """One-peer exponential-graph gossip: at round ``r`` mix with the peers
     at ±2^(r mod ⌈log₂P⌉) — same symmetric 3-neighbor weights as the ring,
     stride cycling through every power-of-two scale. ``round_idx`` is
     traced, so the stride is selected by ``lax.switch`` over the (static)
     log₂P candidate mixes. Doubly stochastic at every stride, so the global
-    mean is preserved exactly and consensus contracts at every round."""
+    mean is preserved exactly and consensus contracts at every round.
+
+    ``mask``: optional ``[L]`` trust verdict, same semantics as
+    :func:`ring_mix` — unverified peers' params are excluded from every
+    mix and their weight reverts to the receiving peer's self-weight.
+    """
     leaves, treedef = jax.tree.flatten(tree)
     l_per_dev = leaves[0].shape[0]
     # Static axis size: shard_map binds mesh axes at trace time.
@@ -103,15 +141,34 @@ def exp_mix(
 
     def mix_at(offset):
         def branch(leaves_in):
-            return [
-                self_weight * x
-                + side
-                * (
-                    _global_shift(x, offset, axis_name)
-                    + _global_shift(x, num_peers - offset, axis_name)
+            if mask is None:
+                return [
+                    self_weight * x
+                    + side
+                    * (
+                        _global_shift(x, offset, axis_name)
+                        + _global_shift(x, num_peers - offset, axis_name)
+                    )
+                    for x in leaves_in
+                ]
+            m = mask.astype(jnp.float32)
+            mf = _global_shift(m, offset, axis_name)
+            mb = _global_shift(m, num_peers - offset, axis_name)
+            out = []
+            for x in leaves_in:
+                bshape = (x.shape[0],) + (1,) * (x.ndim - 1)
+                wf = (side * mf).reshape(bshape).astype(x.dtype)
+                wb = (side * mb).reshape(bshape).astype(x.dtype)
+                ws = (
+                    (self_weight + side * ((1.0 - mf) + (1.0 - mb)))
+                    .reshape(bshape).astype(x.dtype)
                 )
-                for x in leaves_in
-            ]
+                out.append(
+                    ws * x
+                    + wf * _global_shift(x, offset, axis_name)
+                    + wb * _global_shift(x, num_peers - offset, axis_name)
+                )
+            return out
 
         return branch
 

@@ -165,10 +165,12 @@ class MoEFFN(nn.Module):
         return y.reshape(shape)
 
 
-# Leaf-path classification for expert-stacked params: wi/bi/wo/bo are
-# MoEFFN's expert-stacked leaves (no other module uses these names — flax
-# layers name theirs kernel/bias), whether MoEFFN is nested or the root.
-_EXPERT_LEAF = re.compile(r"(^|/)(wi|bi|wo|bo)$")
+# Leaf-path classification for expert-stacked params, anchored on the
+# OWNING MODULE's scope (``.../MoEFFN_k/wi``), not the bare leaf name — a
+# future module reusing wi/bi/wo/bo must not silently get its leading dim
+# expert-sharded. The root-scope alternative covers a bare MoEFFN used as
+# the top-level module (unit tests init it directly).
+_EXPERT_LEAF = re.compile(r"(^|/)MoEFFN_\d+/(wi|bi|wo|bo)$|^(wi|bi|wo|bo)$")
 
 
 def param_specs(params, ep_axis: str = EP_AXIS):

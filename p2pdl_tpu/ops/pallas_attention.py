@@ -433,25 +433,55 @@ def _dense_with_lse(q, k, v, causal):
     return out.astype(q.dtype), lse
 
 
+# Block-size selection. The kernels take any (block_q, block_k) dividing
+# (t_q, t_k) with lane-legal tiles; the best choice is hardware-empirical.
+# ``bench.py --tune-flash`` sweeps the grid with on-device chained-step
+# timing (the only trustworthy clock through the remote-dispatch tunnel)
+# and its findings get baked here, keyed by (seq_len, head_dim); unknown
+# shapes fall back to 128x128 (the MXU-native tile, never illegal).
+# ``P2PDL_FLASH_BLOCKS="bq,bk"`` overrides everything for experiments.
+_BLOCK_TABLE: dict[tuple[int, int], tuple[int, int]] = {
+    # (seq_len, head_dim): (block_q, block_k) — fill from TUNE_FLASH.json.
+}
+
+
+def _default_blocks(t: int, d: int) -> tuple[int, int]:
+    import os
+
+    env = os.environ.get("P2PDL_FLASH_BLOCKS")
+    if env:
+        bq, bk = (int(x) for x in env.split(","))
+    else:
+        bq, bk = _BLOCK_TABLE.get((t, d), (128, 128))
+    # Clamp BOTH paths: an oversized block (table or override) reaching the
+    # kernel at a shorter sequence length is an illegal Mosaic grid.
+    return min(bq, t), min(bk, t)
+
+
 def flash_attention_with_lse(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Fused attention returning ``(out [B,H,T,D], lse [B,H,T])`` — the
     per-row logsumexp lets callers merge partial attention over key blocks
     exactly (flash-inside-ring: ``ops.ring_attention`` with impl='flash').
     Differentiable in both outputs. Same auto-routing as
-    :func:`flash_attention`."""
+    :func:`flash_attention`. ``block_q``/``block_k`` default per-shape via
+    the tuned ``_BLOCK_TABLE``."""
     if interpret is None:
         if not _on_tpu():
             return _dense_with_lse(q, k, v, causal)
         interpret = False
     b, h, t, d = q.shape
+    if block_q is None or block_k is None:
+        dq, dk = _default_blocks(t, d)
+        block_q = block_q or dq
+        block_k = block_k or dk
     flat = lambda x: x.reshape(b * h, x.shape[2], x.shape[-1])
     out, lse = _flash_lse(flat(q), flat(k), flat(v), causal, block_q, block_k, interpret)
     return out.reshape(b, h, t, v.shape[-1]), lse.reshape(b, h, t)
@@ -462,8 +492,8 @@ def flash_attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret=None,
 ) -> jnp.ndarray:
     """Fused attention over ``[B, H, T, D]`` (same contract as ``sdpa``).
@@ -484,6 +514,10 @@ def flash_attention(
             return sdpa(q, k, v, causal=causal)
         interpret = False
     b, h, t, d = q.shape
+    if block_q is None or block_k is None:
+        dq, dk = _default_blocks(t, d)
+        block_q = block_q or dq
+        block_k = block_k or dk
     flat = lambda x: x.reshape(b * h, x.shape[2], x.shape[-1])
     out = _flash(flat(q), flat(k), flat(v), causal, block_q, block_k, interpret)
     return out.reshape(b, h, t, v.shape[-1])

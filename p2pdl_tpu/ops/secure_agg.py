@@ -9,10 +9,15 @@ aggregation (Bonawitz et al., CCS 2017) TPU-natively: each pair of trainers
 makes all masks cancel exactly in the summed aggregate — the server (and any
 eavesdropper on a single link) sees only masked updates.
 
-Scope (documented limitation vs. the full protocol): pairwise keys come from
-a shared experiment key rather than a Diffie-Hellman exchange, and there is
-no dropout-recovery secret-sharing — cancellation assumes the round's trainer
-set completes, which the round driver guarantees in simulation.
+Key derivation (``pair_seeds`` path, the default via the round driver):
+pairwise PRF seeds come from ECDH over per-peer P-256 keys + HKDF
+(``protocol/secure_keys.py``) — underivable from public state — baked into
+the compiled round as a ``[P, P, 2]`` uint32 matrix. Each peer's private
+scalar is Shamir-shared (``protocol/shamir.py``), so when a trainer drops
+AFTER masking (BRB gate-out mid-round), survivors reconstruct its seeds and
+:func:`residual_mask_sum` cancels the orphaned masks out of the aggregate.
+The legacy shared-experiment-key derivation (``base_key`` + ``fold_in``)
+remains for A/B benchmarking only.
 
 Scaling: the full Bonawitz graph costs O(T x model) PRNG *per trainer* —
 O(T^2 x model) per round, which is infeasible at T = 1024 on any hardware
@@ -33,61 +38,95 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _partner_ids(trainer_ids: jnp.ndarray, my_id: jax.Array, neighbors: int) -> jnp.ndarray:
+    """The mask partners of ``my_id`` given this round's trainer vector.
+
+    Shared by masking and residual correction — the two MUST agree on the
+    pairing or orphan cancellation breaks. ``neighbors = 0`` (or >= T-1)
+    pairs with every trainer slot (Bonawitz full graph; self/vacant slots
+    are inert via ``sign``); ``neighbors = k`` pairs with the k ring
+    neighbors by RANK AMONG LIVE entries (Bell-style k-regular graph).
+    """
+    t = trainer_ids.shape[0]
+    if not (neighbors and neighbors < t - 1):
+        return trainer_ids
+    # Ring pairing over the LIVE trainers only, by rank among live
+    # entries (symmetric: offset +d from rank p lands on rank q iff
+    # offset -d from q lands on p), so both endpoints of every pair
+    # include it — cancellation holds. Ranking over live entries (not
+    # raw positions) matters: with -1 vacancy gating in place, a trainer
+    # whose positional neighbors were all gated out would otherwise get
+    # a ZERO mask and enter the "secure" aggregate in plaintext.
+    live = trainer_ids >= 0  # [T]
+    t_idx = jnp.arange(t)
+    my_pos = jnp.argmax(trainer_ids == my_id)
+    my_rank = jnp.sum(live & (t_idx < my_pos))
+    n_live = jnp.maximum(jnp.sum(live), 1)
+    # Live ids first, in positional order (vacancies pushed to the end).
+    order = jnp.argsort(jnp.where(live, t_idx, t + t_idx))
+    live_first = trainer_ids[order]
+    half = neighbors // 2
+    offsets = jnp.concatenate([jnp.arange(1, half + 1), -jnp.arange(1, half + 1)])
+    # When n_live <= neighbors the ring wraps onto my_id itself —
+    # sign(0) = 0 keeps self-pairs inert; duplicated pairs stay
+    # symmetric at both endpoints and still cancel.
+    return live_first[(my_rank + offsets) % n_live]
+
+
+def _pair_prf_key(
+    base_key: jax.Array | None,
+    pair_seeds: jnp.ndarray | None,
+    round_idx: jax.Array | None,
+    my_id: jax.Array,
+    other: jax.Array,
+    leaf_idx: int,
+) -> jax.Array:
+    """The PRF key for pair ``(my_id, other)`` at one leaf.
+
+    ``pair_seeds`` given: key from the ECDH-derived ``[P, P, 2]`` seed
+    matrix (both uint32 halves folded in) + round index — reconstructible
+    for a dropped peer from its Shamir-shared scalar, underivable from
+    public state. Otherwise: legacy order-independent fold chain on the
+    shared ``base_key`` (already round-folded by the driver).
+    """
+    if pair_seeds is not None:
+        # Clamp vacant ids for the gather only; callers zero the
+        # contribution via sign() gating.
+        s = pair_seeds[jnp.maximum(my_id, 0), jnp.maximum(other, 0)]  # [2] uint32
+        k = jax.random.fold_in(jax.random.PRNGKey(s[0]), s[1])
+        if round_idx is not None:
+            k = jax.random.fold_in(k, round_idx)
+        return jax.random.fold_in(k, leaf_idx)
+    lo = jnp.minimum(my_id, other)
+    hi = jnp.maximum(my_id, other)
+    return jax.random.fold_in(
+        jax.random.fold_in(jax.random.fold_in(base_key, lo), hi), leaf_idx
+    )
+
+
 def pairwise_mask(
-    base_key: jax.Array,
+    base_key: jax.Array | None,
     my_id: jax.Array,
     trainer_ids: jnp.ndarray,
     tree: Any,
     neighbors: int = 0,
+    pair_seeds: jnp.ndarray | None = None,
+    round_idx: jax.Array | None = None,
 ) -> Any:
     """The net mask trainer ``my_id`` adds: ``sum_j sign(j - i) * PRF(i, j)``
-    over its mask partners.
+    over its mask partners (see :func:`_partner_ids` for the pairing and
+    :func:`_pair_prf_key` for the two key-derivation modes).
 
     ``trainer_ids``: ``[T]`` global peer ids of this round's trainers.
-    ``neighbors = 0`` pairs with every other trainer (Bonawitz full graph);
-    ``neighbors = k`` pairs with the k ring neighbors at offsets
-    ``+/-1..k//2`` in the trainer vector (Bell-style k-regular graph). The
-    PRF key for a pair is order-independent (``fold_in(min) -> fold_in(max)``)
-    so both endpoints derive the same mask; ``sign`` is antisymmetric and
-    zero for ``j == i`` (self-pair contributes nothing). Returns a pytree
-    shaped like ``tree``.
+    ``sign`` is antisymmetric and zero for ``j == i`` (self-pair contributes
+    nothing). Returns a pytree shaped like ``tree``.
     """
-    t = trainer_ids.shape[0]
-    if neighbors and neighbors < t - 1:
-        # Ring pairing over the LIVE trainers only, by rank among live
-        # entries (symmetric: offset +d from rank p lands on rank q iff
-        # offset -d from q lands on p), so both endpoints of every pair
-        # include it — cancellation holds. Ranking over live entries (not
-        # raw positions) matters: with -1 vacancy gating in place, a trainer
-        # whose positional neighbors were all gated out would otherwise get
-        # a ZERO mask and enter the "secure" aggregate in plaintext.
-        live = trainer_ids >= 0  # [T]
-        t_idx = jnp.arange(t)
-        my_pos = jnp.argmax(trainer_ids == my_id)
-        my_rank = jnp.sum(live & (t_idx < my_pos))
-        n_live = jnp.maximum(jnp.sum(live), 1)
-        # Live ids first, in positional order (vacancies pushed to the end).
-        order = jnp.argsort(jnp.where(live, t_idx, t + t_idx))
-        live_first = trainer_ids[order]
-        half = neighbors // 2
-        offsets = jnp.concatenate(
-            [jnp.arange(1, half + 1), -jnp.arange(1, half + 1)]
-        )
-        partners = live_first[(my_rank + offsets) % n_live]
-        # When n_live <= neighbors the ring wraps onto my_id itself —
-        # sign(0) = 0 keeps self-pairs inert; duplicated pairs stay
-        # symmetric at both endpoints and still cancel.
-    else:
-        partners = trainer_ids
+    partners = _partner_ids(trainer_ids, my_id, neighbors)
     leaves, treedef = jax.tree.flatten(tree)
 
     def mask_for_leaf(leaf_idx: int, leaf: jnp.ndarray) -> jnp.ndarray:
         def body(acc, other):
-            lo = jnp.minimum(my_id, other)
-            hi = jnp.maximum(my_id, other)
-            k = jax.random.fold_in(
-                jax.random.fold_in(jax.random.fold_in(base_key, lo), hi), leaf_idx
-            )
+            k = _pair_prf_key(base_key, pair_seeds, round_idx, my_id, other, leaf_idx)
             m = jax.random.normal(k, leaf.shape, jnp.float32)
             sgn = jnp.sign(other - my_id).astype(jnp.float32)
             # Vacant slots (id -1, dynamic-participation padding) must not
@@ -108,17 +147,77 @@ def pairwise_mask(
 
 def apply_masks(
     deltas: Any,
-    base_key: jax.Array,
+    base_key: jax.Array | None,
     my_id: jax.Array,
     trainer_ids: jnp.ndarray,
     is_trainer: jax.Array,
     neighbors: int = 0,
+    pair_seeds: jnp.ndarray | None = None,
+    round_idx: jax.Array | None = None,
 ) -> Any:
     """Add this peer's net pairwise mask to its delta (no-op for non-trainers)."""
-    mask = pairwise_mask(base_key, my_id, trainer_ids, deltas, neighbors=neighbors)
+    mask = pairwise_mask(
+        base_key, my_id, trainer_ids, deltas,
+        neighbors=neighbors, pair_seeds=pair_seeds, round_idx=round_idx,
+    )
     gate = is_trainer.astype(jnp.float32)
 
     def leaf(d, m):
         return d + (gate * m.astype(jnp.float32)).astype(d.dtype)
 
     return jax.tree.map(leaf, deltas, mask)
+
+
+def residual_mask_sum(
+    tree: Any,
+    masked_ids: jnp.ndarray,
+    gated_ids: jnp.ndarray,
+    neighbors: int = 0,
+    base_key: jax.Array | None = None,
+    pair_seeds: jnp.ndarray | None = None,
+    round_idx: jax.Array | None = None,
+) -> Any:
+    """The orphaned-mask residue left in a gated sum, for subtraction.
+
+    Trainers mask against the PRE-gate trainer vector ``masked_ids`` (what
+    they knew when they shipped); the aggregate then admits only
+    ``gated_ids`` (BRB survivors). Masks between two survivors cancel; a
+    pair (survivor s, dropped d) leaves ``sign(d - s) * mask_sd`` orphaned
+    inside s's admitted delta. This returns
+
+        ``sum over s in gated, d in partners(s) \\ gated of
+          sign(d - s) * PRF_mask(s, d)``
+
+    — computable by the aggregator only with the dropped peers' pair seeds,
+    i.e. after Shamir dropout recovery
+    (``protocol/secure_keys.SecureAggKeyring.reconstruct_seeds_for_dropped``);
+    in the SPMD engine the reconstructed-equal seed matrix is already baked
+    into the program. Partner derivation reuses :func:`_partner_ids` on
+    ``masked_ids`` so the pairing matches masking exactly. Cost matches one
+    peer's masking pass: O(T x partners x model) PRF draws, replicated.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    t = masked_ids.shape[0]
+
+    def resid_for_leaf(leaf_idx: int, leaf: jnp.ndarray) -> jnp.ndarray:
+        def outer(acc, s):
+            survived = (s >= 0) & jnp.isin(s, gated_ids)
+            partners = _partner_ids(masked_ids, s, neighbors)
+
+            def inner(acc2, d):
+                orphan = (d >= 0) & ~jnp.isin(d, gated_ids)
+                k = _pair_prf_key(base_key, pair_seeds, round_idx, s, d, leaf_idx)
+                m = jax.random.normal(k, leaf.shape, jnp.float32)
+                sgn = jnp.sign(d - s).astype(jnp.float32)
+                w = jnp.where(survived & orphan, sgn, 0.0)
+                return acc2 + w * m, None
+
+            acc, _ = lax.scan(inner, acc, partners)
+            return acc, None
+
+        acc0 = (leaf * 0).astype(jnp.float32)
+        out, _ = lax.scan(outer, acc0, masked_ids)
+        return out.astype(leaf.dtype)
+
+    resid = [resid_for_leaf(i, l) for i, l in enumerate(leaves)]
+    return jax.tree.unflatten(treedef, resid)

@@ -22,6 +22,7 @@ from p2pdl_tpu.parallel.round import (
     build_multi_round_fn,
     build_per_peer_eval_fn,
     build_round_fn,
+    build_gossip_trust_round_fns,
     build_trust_round_fns,
 )
 
@@ -36,6 +37,7 @@ __all__ = [
     "params_layout",
     "build_round_fn",
     "build_multi_round_fn",
+    "build_gossip_trust_round_fns",
     "build_trust_round_fns",
     "build_eval_fn",
     "build_per_peer_eval_fn",

@@ -50,7 +50,7 @@ from p2pdl_tpu.config import Config
 from p2pdl_tpu.ops import aggregators, sharded_aggregators
 from p2pdl_tpu.ops.attacks import apply_attack
 from p2pdl_tpu.ops.gossip import exp_mix, ring_mix
-from p2pdl_tpu.ops.secure_agg import apply_masks
+from p2pdl_tpu.ops.secure_agg import apply_masks, residual_mask_sum
 from p2pdl_tpu.parallel.mesh import (
     EP_AXIS,
     PEER_AXIS,
@@ -301,7 +301,28 @@ def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
     )
 
 
-def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
+def _resolve_pair_seeds(cfg: Config, pair_seeds):
+    """The key-derivation mode follows ``cfg.secure_agg_keys``, not whether
+    the caller happened to plumb a matrix: with the default "ecdh" and no
+    injected seeds, build the keyring here (from ``cfg.seed``, so every
+    builder derives the identical matrix) — otherwise a direct
+    ``build_round_fn`` caller would silently get the legacy shared-key
+    masks the config says are for A/B benchmarking only. The driver still
+    injects its own matrix so rotation state stays with its keyring."""
+    if (
+        pair_seeds is None
+        and cfg.aggregator == "secure_fedavg"
+        and cfg.secure_agg_keys == "ecdh"
+    ):
+        from p2pdl_tpu.protocol.secure_keys import SecureAggKeyring
+
+        pair_seeds = SecureAggKeyring(cfg.num_peers, seed=cfg.seed).seed_matrix()
+    return pair_seeds
+
+
+def build_round_fn(
+    cfg: Config, mesh: Mesh, attack: str = "none", pair_seeds=None
+) -> Callable:
     """Compile the fused round: ``(state, x, y, trainer_idx, byz_gate,
     mask_key) -> (state', metrics)``.
 
@@ -324,6 +345,7 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
     The input ``state`` is donated: the round overwrites it in place, so the
     caller must use the returned state (all call sites thread it through).
     """
+    pair_seeds = _resolve_pair_seeds(cfg, pair_seeds)
     seq_axis, tp_axis, ep_axis, pp_axis = _mesh_axes_for(cfg, mesh)
     model = build_model(
         cfg, seq_axis=seq_axis, tp_axis=tp_axis, ep_axis=ep_axis, pp_axis=pp_axis
@@ -337,14 +359,15 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
         params_spec = P(PEER_AXIS)
     elif cfg.peer_chunk > 0:
         # Explicit request to stream the peer stack (memory over speed).
-        body = _chunked_sync_body(cfg, attack, model, opt, l_per_dev)
+        body = _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=pair_seeds)
         params_spec = P()
     elif _use_fast_sync_path(cfg, attack):
         body = _fast_sync_body(cfg, model, l_per_dev)
         params_spec = P()
     else:
         body = _general_sync_body(
-            cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis, ep_axis=ep_axis
+            cfg, attack, model, opt, l_per_dev,
+            seq_axis=seq_axis, ep_axis=ep_axis, pair_seeds=pair_seeds,
         )
         params_spec = P()
     sp = P(PEER_AXIS)
@@ -401,7 +424,9 @@ def build_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
     return jax.jit(round_fn, donate_argnums=(0,))
 
 
-def build_multi_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Callable:
+def build_multi_round_fn(
+    cfg: Config, mesh: Mesh, attack: str = "none", pair_seeds=None
+) -> Callable:
     """Compile R rounds as ONE device program: ``(state, x, y, trainer_mat
     [R, T], byz_gate, base_key) -> (state', {"train_loss": [R, P]})``.
 
@@ -420,6 +445,7 @@ def build_multi_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Calla
     """
     if cfg.brb_enabled:
         raise ValueError("fused rounds cannot host the BRB trust plane between phases")
+    pair_seeds = _resolve_pair_seeds(cfg, pair_seeds)
     seq_axis, tp_axis, ep_axis, pp_axis = _mesh_axes_for(cfg, mesh)
     model = build_model(
         cfg, seq_axis=seq_axis, tp_axis=tp_axis, ep_axis=ep_axis, pp_axis=pp_axis
@@ -430,14 +456,15 @@ def build_multi_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Calla
         body = _gossip_body(cfg, mesh, attack, model, opt, l_per_dev, emit_delta=False)
         params_spec = P(PEER_AXIS)
     elif cfg.peer_chunk > 0:
-        body = _chunked_sync_body(cfg, attack, model, opt, l_per_dev)
+        body = _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=pair_seeds)
         params_spec = P()
     elif _use_fast_sync_path(cfg, attack):
         body = _fast_sync_body(cfg, model, l_per_dev)
         params_spec = P()
     else:
         body = _general_sync_body(
-            cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis, ep_axis=ep_axis
+            cfg, attack, model, opt, l_per_dev,
+            seq_axis=seq_axis, ep_axis=ep_axis, pair_seeds=pair_seeds,
         )
         params_spec = P()
     sp = P(PEER_AXIS)
@@ -499,7 +526,9 @@ def build_multi_round_fn(cfg: Config, mesh: Mesh, attack: str = "none") -> Calla
     return jax.jit(multi_round_fn, donate_argnums=(0,))
 
 
-def build_trust_round_fns(cfg: Config, mesh: Mesh, attack: str = "none") -> tuple[Callable, Callable]:
+def build_trust_round_fns(
+    cfg: Config, mesh: Mesh, attack: str = "none", pair_seeds=None
+) -> tuple[Callable, Callable]:
     """The BRB-gated round: local training and aggregation as two compiled
     programs with the host trust plane deciding between them which trainers'
     updates the aggregate admits.
@@ -514,10 +543,14 @@ def build_trust_round_fns(cfg: Config, mesh: Mesh, attack: str = "none") -> tupl
     - The driver digests each live trainer's delta
       (``crypto.digest_update``), BRB-broadcasts the digests, and replaces
       undelivered/unverified trainers with ``-1`` in the trainer vector.
-    - ``agg_fn(state, delta, new_opt, trainer_idx, mask_key) -> state'``:
-      masked aggregation over the *gated* trainer vector + server update.
-      A gated-out trainer contributes nothing to this round's aggregate (and
-      its optimizer state does not advance, exactly as if never sampled).
+    - ``agg_fn(state, delta, new_opt, trainer_idx, mask_key, masked_idx=None)
+      -> state'``: masked aggregation over the *gated* trainer vector +
+      server update. A gated-out trainer contributes nothing to this round's
+      aggregate (and its optimizer state does not advance, exactly as if
+      never sampled). Under secure_fedavg the driver passes ``masked_idx``
+      (the pre-gate trainer vector) so the orphaned pairwise masks a
+      gated-out trainer left in its surviving partners' deltas are cancelled
+      by ``residual_mask_sum`` — the Bonawitz dropout-recovery semantic.
 
     Gating applies to the mean family (fedavg/secure_fedavg, via ``-1``
     vacancy). The gathered robust reducers take their full update matrix —
@@ -530,11 +563,15 @@ def build_trust_round_fns(cfg: Config, mesh: Mesh, attack: str = "none") -> tupl
     """
     if params_layout(cfg) == "peer":
         raise ValueError("gossip has no gated aggregate; use build_round_fn")
+    pair_seeds = _resolve_pair_seeds(cfg, pair_seeds)
     model = build_model(cfg)
     opt = make_optimizer(cfg)
     l_per_dev = peers_per_device(cfg.num_peers, mesh)
     train = _local_train_phase(cfg, attack, model, opt, l_per_dev)
-    agg = _aggregate_phase(cfg, l_per_dev)
+    # Runtime seeds: key rotation after dropout recovery swaps the matrix
+    # without recompiling the aggregate.
+    runtime_seeds = pair_seeds is not None
+    agg = _aggregate_phase(cfg, l_per_dev, gated=True, runtime_seeds=runtime_seeds)
     sp = P(PEER_AXIS)
     sr = P()
     train_smapped = jax.shard_map(
@@ -546,7 +583,7 @@ def build_trust_round_fns(cfg: Config, mesh: Mesh, attack: str = "none") -> tupl
     agg_smapped = jax.shard_map(
         agg,
         mesh=mesh,
-        in_specs=(sr, sp, sp, sp, sr, sr),
+        in_specs=(sr, sp, sp, sp, sr, sr, sr, sr) + ((sr,) if runtime_seeds else ()),
         out_specs=(sr, sp),
     )
 
@@ -562,9 +599,21 @@ def build_trust_round_fns(cfg: Config, mesh: Mesh, attack: str = "none") -> tupl
             mask_key,
         )
 
-    def agg_fn(state: PeerState, delta, new_opt, trainer_idx, mask_key):
+    def agg_fn(state: PeerState, delta, new_opt, trainer_idx, mask_key, masked_idx=None, seeds=None):
+        # ``masked_idx``: the PRE-gate trainer vector the deltas were masked
+        # against (driver passes it under secure_fedavg so orphaned masks of
+        # gated-out trainers get cancelled); defaults to the gated vector
+        # for callers without mid-round dropout (no residual exists then).
+        # ``seeds``: the CURRENT ECDH seed matrix (rotation-aware) when the
+        # phase was built with one.
+        if masked_idx is None:
+            masked_idx = trainer_idx
+        if runtime_seeds and seeds is None:
+            raise ValueError("this agg_fn was built with runtime seeds; pass seeds=")
+        extra = (seeds,) if runtime_seeds else ()
         new_params, kept_opt = agg_smapped(
-            state.params, state.opt_state, new_opt, delta, trainer_idx, mask_key
+            state.params, state.opt_state, new_opt, delta, trainer_idx,
+            masked_idx, mask_key, state.round_idx, *extra,
         )
         return PeerState(
             params=new_params,
@@ -577,6 +626,92 @@ def build_trust_round_fns(cfg: Config, mesh: Mesh, attack: str = "none") -> tupl
     # the previous state — donate all three; train_fn's inputs are all read
     # again by agg_fn, so it donates nothing.
     return jax.jit(train_fn), jax.jit(agg_fn, donate_argnums=(0, 1, 2))
+
+
+def build_gossip_trust_round_fns(
+    cfg: Config, mesh: Mesh, attack: str = "none"
+) -> tuple[Callable, Callable]:
+    """The BRB-gated gossip round: train and mix as two compiled programs
+    with the trust verdict deciding the mixing weights between them.
+
+    Round 3 ran gossip BRB observationally — an equivocator's corrupted
+    params still mixed into its neighbors in the round where it cheated,
+    with exclusion arriving one round late. Here the mix itself is gated
+    (the reference's aggregate-only-verified semantic, reference
+    ``node/node.py:130-145``, applied to the in-band mix):
+
+    - ``train_fn(state, x, y, byz_gate, mask_key) -> (attacked, new_opt,
+      losses, delta)``: every peer trains and (if Byzantine) corrupts; its
+      post-update params stay peer-local on device, its delta is digested
+      and BRB-broadcast by the host.
+    - ``mix_fn(state, attacked, new_opt, verdict) -> state'``: the
+      graph mix with an UNVERIFIED peer's weight zeroed in every
+      neighbor's row (mass reverting to self) — its params provably never
+      enter any honest peer's round-r mix (test-asserted). ``verdict``:
+      ``[P]`` 1.0 = delivered + digest-verified.
+    """
+    if params_layout(cfg) != "peer":
+        raise ValueError("gossip trust round requires the peer params layout")
+    model = build_model(cfg)
+    opt = make_optimizer(cfg)
+    l_per_dev = peers_per_device(cfg.num_peers, mesh)
+    local_train = make_local_train(cfg, model, opt)
+    sp = P(PEER_AXIS)
+    sr = P()
+
+    def train_phase(params, opt_state, rng, x, y, byz_gate, round_idx, mask_key):
+        dev = lax.axis_index(PEER_AXIS)
+        local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
+        round_keys = jax.vmap(lambda k: jax.random.fold_in(k, round_idx))(rng)
+        new_params, new_opt, losses = jax.vmap(local_train)(
+            params, opt_state, round_keys, x, y
+        )
+        delta = jax.tree.map(lambda n, p: n - p, new_params, params)
+        gate = byz_gate[local_ids]
+        delta = apply_attack(
+            attack, delta, gate, mask_key,
+            axis_name=PEER_AXIS, peer_ids=local_ids,
+        )
+        attacked = jax.tree.map(lambda p, d: p + d, params, delta)
+        return attacked, new_opt, losses, delta
+
+    def mix_phase(attacked, verdict, round_idx):
+        dev = lax.axis_index(PEER_AXIS)
+        local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
+        vm = verdict[local_ids]
+        return (
+            exp_mix(attacked, round_idx, mask=vm)
+            if cfg.gossip_graph == "exponential"
+            else ring_mix(attacked, mask=vm)
+        )
+
+    train_smapped = jax.shard_map(
+        train_phase,
+        mesh=mesh,
+        in_specs=(sp, sp, sp, sp, sp, sr, sr, sr),
+        out_specs=(sp, sp, sp, sp),
+    )
+    mix_smapped = jax.shard_map(
+        mix_phase, mesh=mesh, in_specs=(sp, sr, sr), out_specs=sp
+    )
+
+    def train_fn(state: PeerState, x, y, byz_gate, mask_key):
+        return train_smapped(
+            state.params, state.opt_state, state.rng, x, y,
+            byz_gate, state.round_idx, mask_key,
+        )
+
+    def mix_fn(state: PeerState, attacked, new_opt, verdict):
+        mixed = mix_smapped(attacked, verdict, state.round_idx)
+        return PeerState(
+            params=mixed,
+            opt_state=new_opt,
+            rng=state.rng,
+            round_idx=state.round_idx + 1,
+        )
+
+    # mix_fn consumes the round transients and the previous state.
+    return jax.jit(train_fn), jax.jit(mix_fn, donate_argnums=(0, 1, 2))
 
 
 def _gossip_body(cfg, mesh, attack, model, opt, l_per_dev, emit_delta=False):
@@ -598,8 +733,8 @@ def _gossip_body(cfg, mesh, attack, model, opt, l_per_dev, emit_delta=False):
         delta = jax.tree.map(lambda n, p: n - p, new_params, params)
         gate = byz_gate[local_ids]
         delta = apply_attack(
-            attack, delta, gate, jax.random.fold_in(mask_key, dev),
-            axis_name=PEER_AXIS,
+            attack, delta, gate, mask_key,
+            axis_name=PEER_AXIS, peer_ids=local_ids,
         )
         attacked = jax.tree.map(lambda p, d: p + d, params, delta)
         mixed = (
@@ -685,32 +820,56 @@ def _local_train_phase(cfg, attack, model, opt, l_per_dev, seq_axis=None, ep_axi
         delta = jax.tree.map(lambda n, p: n - p[None], new_params, pvaried)
         gate = byz_gate[local_ids]
         delta = apply_attack(
-            attack, delta, gate, jax.random.fold_in(mask_key, dev),
-            axis_name=PEER_AXIS,
+            attack, delta, gate, mask_key,
+            axis_name=PEER_AXIS, peer_ids=local_ids,
         )
         return delta, new_opt, losses
 
     return phase
 
 
-def _aggregate_phase(cfg, l_per_dev):
+def _aggregate_phase(cfg, l_per_dev, pair_seeds=None, gated=False, runtime_seeds=False):
     """Phase fragment (inside ``shard_map``): admit the trainer-gated deltas
     into the aggregate, apply one deterministic server update, and advance
     only trainers' optimizer state — the reference's tester-side
-    accumulate/average/apply (reference ``aggregator/aggregation.py:15-38``)."""
+    accumulate/average/apply (reference ``aggregator/aggregation.py:15-38``).
 
-    def phase(params, opt_state, new_opt, delta, trainer_idx, mask_key):
+    Secure aggregation keys on ``pair_seeds`` when given (the ECDH-derived
+    ``[P, P, 2]`` matrix from ``protocol/secure_keys``, baked in as a
+    compile-time constant) and otherwise on the legacy shared ``mask_key``.
+    With ``gated=True`` (the BRB trust pipeline) masks pair over the
+    PRE-gate trainer vector ``masked_idx`` — what each trainer knew when it
+    shipped its masked update — and the orphaned masks a gated-out trainer
+    leaves in its surviving partners' deltas are cancelled by subtracting
+    ``residual_mask_sum`` (the Shamir dropout-recovery flow, reference-less:
+    the reference has no masking at all).
+
+    ``runtime_seeds=True`` (the gated driver path) takes the seed matrix as
+    a trailing RUNTIME argument instead of a baked constant, so key ROTATION
+    after a dropout-recovery event (``SecureAggKeyring.rotate``) swaps in
+    fresh seeds without recompiling."""
+    const = None if runtime_seeds else (
+        jnp.asarray(pair_seeds) if pair_seeds is not None else None
+    )
+
+    def core(params, opt_state, new_opt, delta, trainer_idx, masked_idx, mask_key, round_idx, *seeds_arg):
+        seeds_const = seeds_arg[0] if runtime_seeds else const
         dev = lax.axis_index(PEER_AXIS)
         local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
         is_trainer = jnp.isin(local_ids, trainer_idx)
 
         if cfg.aggregator == "secure_fedavg":
+            # Every PRE-gate trainer masked before the gate fell; gated-out
+            # trainers' (masked) deltas are excluded wholesale by the
+            # is_trainer weights below.
+            is_masked = jnp.isin(local_ids, masked_idx)
             delta = jax.vmap(
                 lambda d, pid, it: apply_masks(
-                    d, mask_key, pid, trainer_idx, it,
+                    d, mask_key, pid, masked_idx, it,
                     neighbors=cfg.secure_agg_neighbors,
+                    pair_seeds=seeds_const, round_idx=round_idx,
                 )
-            )(delta, local_ids, is_trainer)
+            )(delta, local_ids, is_masked)
 
         if cfg.aggregator in ("fedavg", "secure_fedavg"):
             count = jnp.maximum(
@@ -723,6 +882,28 @@ def _aggregate_phase(cfg, l_per_dev):
                 return lax.psum(jnp.sum(d * w, axis=0), PEER_AXIS) / count.astype(d.dtype)
 
             agg = jax.tree.map(leaf, delta)
+            if gated and cfg.aggregator == "secure_fedavg":
+                # lax.cond on the replicated drop predicate: the residual is
+                # a sequential scan-of-scans of O(T x partners) model-sized
+                # PRF draws — provably zero (and pure waste) in the common
+                # no-dropout round, so don't execute it there.
+                def with_resid(a):
+                    resid = residual_mask_sum(
+                        a, masked_idx, trainer_idx,
+                        neighbors=cfg.secure_agg_neighbors,
+                        base_key=mask_key, pair_seeds=seeds_const, round_idx=round_idx,
+                    )
+                    return jax.tree.map(
+                        lambda x, r: x - r.astype(x.dtype) / count.astype(x.dtype),
+                        a, resid,
+                    )
+
+                agg = lax.cond(
+                    jnp.any(masked_idx != trainer_idx),
+                    with_resid,
+                    lambda a: a,
+                    agg,
+                )
         elif cfg.robust_impl == "blockwise":
             # Stream the peer axis through feature blocks: O(P x block)
             # transient instead of O(P x model) per device (SURVEY §7 hard
@@ -762,10 +943,21 @@ def _aggregate_phase(cfg, l_per_dev):
         new_opt = jax.tree.map(keep_trainers, new_opt, opt_state)
         return new_p, new_opt
 
+    if gated:
+        return core
+
+    def phase(params, opt_state, new_opt, delta, trainer_idx, mask_key, round_idx):
+        # Non-gated callers: nobody drops between masking and aggregation,
+        # so masked == gated and no residual exists.
+        return core(
+            params, opt_state, new_opt, delta, trainer_idx, trainer_idx,
+            mask_key, round_idx,
+        )
+
     return phase
 
 
-def _chunked_sync_body(cfg, attack, model, opt, l_per_dev):
+def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
     """Role-based round streaming the PEER-STACK axis through fixed-size
     chunks, with the masked-sum aggregation FUSED into the chunk loop.
 
@@ -782,20 +974,27 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev):
     Only the mean family (fedavg / secure_fedavg) can fuse its aggregation
     into a running sum; plain SGD only (no per-peer optimizer state to
     advance), both enforced by Config validation. Results equal the
-    unchunked general body exactly for deterministic attacks
-    (test-asserted); the "noise" attack draws per-chunk keys, so its draws
-    differ from the unchunked layout while the statistics match.
+    unchunked general body exactly for deterministic attacks and (by
+    per-global-peer-id draw keys) the "noise" attack (test-asserted).
+
+    ALIE streams too: the envelope ``mean_h - z * std_h`` needs the honest
+    population's moments, which no single chunk sees — but every attacker
+    submits the SAME envelope value, and the mean family only consumes the
+    trainer-gated SUM. So the scan accumulates honest raw moments
+    (``sum x``, ``sum x^2``, honest count) alongside the fold, zeroes
+    Byzantine trainers' contributions inside it, and adds
+    ``n_byz_trainers x envelope`` once after the cross-device psum — one
+    training pass, O(model) extra transient, exact up to the raw-vs-centered
+    variance rounding (test-asserted vs the unchunked body).
     """
     local_train = make_local_train(cfg, model, opt)
+    seeds_const = jnp.asarray(pair_seeds) if pair_seeds is not None else None
     chunk = cfg.peer_chunk
     if l_per_dev % chunk != 0:
         raise ValueError(
             f"peer_chunk ({chunk}) must divide peers-per-device ({l_per_dev})"
         )
-    if attack == "alie":
-        # ALIE reads the honest population's moments; a chunk sees only its
-        # own peers, so the streamed body would compute the wrong envelope.
-        raise ValueError("attack='alie' is not supported with peer_chunk")
+    alie = attack == "alie"
     n_chunks = l_per_dev // chunk
 
     def body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
@@ -815,24 +1014,45 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev):
             to_chunks, (opt_state, round_keys, x, y, local_ids, byz_gate[local_ids])
         )
 
-        def chunk_step(acc, inputs):
+        def chunk_step(carry, inputs):
+            acc, moments = carry
             opt_c, keys_c, x_c, y_c, ids_c, gate_c, cidx = inputs
             new_params, _, losses = jax.vmap(
                 local_train, in_axes=(None, 0, 0, 0, 0)
             )(pvaried, opt_c, keys_c, x_c, y_c)
             delta = jax.tree.map(lambda n, p: n - p[None], new_params, pvaried)
-            delta = apply_attack(
-                attack,
-                delta,
-                gate_c,
-                jax.random.fold_in(jax.random.fold_in(mask_key, dev), cidx),
-            )
             is_trainer = jnp.isin(ids_c, trainer_idx)
+            if alie:
+                # Stream the honest raw moments; zero Byzantine trainers'
+                # own contributions (their envelope lands post-psum).
+                s1, s2, n_h, n_bt = moments
+                honest = (1.0 - gate_c).astype(jnp.float32)
+
+                def h_of(l):
+                    return honest.reshape((chunk,) + (1,) * (l.ndim - 1)).astype(l.dtype)
+
+                s1 = jax.tree.map(
+                    lambda a, l: a + jnp.sum(l * h_of(l), axis=0), s1, delta
+                )
+                s2 = jax.tree.map(
+                    lambda a, l: a + jnp.sum(l * l * h_of(l), axis=0), s2, delta
+                )
+                moments = (
+                    s1, s2,
+                    n_h + jnp.sum(honest),
+                    n_bt + jnp.sum(gate_c * is_trainer.astype(gate_c.dtype)),
+                )
+                delta = jax.tree.map(lambda l: l * h_of(l), delta)
+            else:
+                delta = apply_attack(
+                    attack, delta, gate_c, mask_key, peer_ids=ids_c
+                )
             if cfg.aggregator == "secure_fedavg":
                 delta = jax.vmap(
                     lambda d, pid, it: apply_masks(
                         d, mask_key, pid, trainer_idx, it,
                         neighbors=cfg.secure_agg_neighbors,
+                        pair_seeds=seeds_const, round_idx=round_idx,
                     )
                 )(delta, ids_c, is_trainer)
 
@@ -842,15 +1062,47 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev):
                 )
                 return a + jnp.sum(d * w, axis=0)
 
-            return jax.tree.map(fold, acc, delta), losses
+            return (jax.tree.map(fold, acc, delta), moments), losses
 
         acc0 = jax.tree.map(jnp.zeros_like, pvaried)
-        acc, losses = lax.scan(
-            chunk_step, acc0, chunked + (jnp.arange(n_chunks),)
+        # Moment accumulators only exist under ALIE — otherwise the scan
+        # carry would haul two dead model-sized trees through every chunk.
+        # Scalar accumulators must start peer-VARYING (they sum the
+        # peer-varying gate), or the scan carry types mismatch.
+        zvar = lambda: jax.lax.pcast(jnp.float32(0.0), PEER_AXIS, to="varying")  # noqa: E731
+        mom0 = (
+            (
+                jax.tree.map(jnp.zeros_like, pvaried),
+                jax.tree.map(jnp.zeros_like, pvaried),
+                zvar(),
+                zvar(),
+            )
+            if alie
+            else ()
         )
-        agg = jax.tree.map(
-            lambda a: lax.psum(a, PEER_AXIS) / count.astype(a.dtype), acc
+        (acc, moments), losses = lax.scan(
+            chunk_step, (acc0, mom0), chunked + (jnp.arange(n_chunks),)
         )
+        if alie:
+            from p2pdl_tpu.ops.attacks import ALIE_Z
+
+            s1, s2, n_h, n_bt = lax.psum(moments, PEER_AXIS)
+            n_h = jnp.maximum(n_h, 1.0)
+
+            def envelope(a, m1, m2):
+                mean = m1 / n_h.astype(m1.dtype)
+                var = jnp.maximum(m2 / n_h.astype(m2.dtype) - mean * mean, 0.0)
+                bad = mean - jnp.asarray(ALIE_Z, mean.dtype) * jnp.sqrt(var)
+                return a + n_bt.astype(a.dtype) * bad
+
+            acc = jax.tree.map(
+                envelope, jax.tree.map(lambda a: lax.psum(a, PEER_AXIS), acc), s1, s2
+            )
+            agg = jax.tree.map(lambda a: a / count.astype(a.dtype), acc)
+        else:
+            agg = jax.tree.map(
+                lambda a: lax.psum(a, PEER_AXIS) / count.astype(a.dtype), acc
+            )
         new_p = jax.tree.map(
             lambda p, a: p + cfg.server_lr * a.astype(p.dtype), params, agg
         )
@@ -861,7 +1113,9 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev):
     return body
 
 
-def _general_sync_body(cfg, attack, model, opt, l_per_dev, seq_axis=None, ep_axis=None):
+def _general_sync_body(
+    cfg, attack, model, opt, l_per_dev, seq_axis=None, ep_axis=None, pair_seeds=None
+):
     """Role-based round over single-copy global params: broadcast the global
     model into a vmapped local-SGD phase (peers diverge only transiently),
     aggregate trainer deltas, apply one deterministic server update. One
@@ -869,13 +1123,15 @@ def _general_sync_body(cfg, attack, model, opt, l_per_dev, seq_axis=None, ep_axi
     train = _local_train_phase(
         cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis, ep_axis=ep_axis
     )
-    agg = _aggregate_phase(cfg, l_per_dev)
+    agg = _aggregate_phase(cfg, l_per_dev, pair_seeds=pair_seeds)
 
     def body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
         delta, new_opt, losses = train(
             params, opt_state, rng, x, y, byz_gate, round_idx, mask_key
         )
-        new_p, kept_opt = agg(params, opt_state, new_opt, delta, trainer_idx, mask_key)
+        new_p, kept_opt = agg(
+            params, opt_state, new_opt, delta, trainer_idx, mask_key, round_idx
+        )
         return new_p, kept_opt, losses
 
     return body

@@ -1,0 +1,87 @@
+"""Shamir secret sharing over the P-256 group order.
+
+Dropout recovery for secure aggregation (Bonawitz et al., CCS 2017 §4):
+each trainer t-of-n shares its ECDH private scalar among the peer set at
+setup. If it drops after shipping a masked update, any threshold of
+survivors can hand the aggregator enough shares to reconstruct the
+dropped trainer's ECDH key, re-derive its pairwise mask seeds, and cancel
+the orphaned masks out of the aggregate (``ops/secure_agg.residual_mask_sum``).
+
+The reference has no secrecy at all — updates travel as plaintext pickle
+(reference ``utils/broadcast.py:8-37``) — so this subsystem has no
+reference counterpart to cite beyond the ECDSA key infrastructure it
+piggybacks on (reference ``utils/crypto.py:42-48``).
+
+The field is GF(q) with q = the secp256r1 group order, so any valid ECDH
+private scalar (1 <= s < q) is a field element and reconstruction returns
+it exactly. Shares are (x, y) integer pairs with x in 1..n.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+# secp256r1 (NIST P-256) group order — the scalar field of the curve the
+# PKI already uses (protocol/crypto.py).
+P256_ORDER = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+
+
+def _eval_poly(coeffs: list[int], x: int, q: int) -> int:
+    """Horner evaluation of ``sum(coeffs[k] * x^k)`` mod q."""
+    acc = 0
+    for c in reversed(coeffs):
+        acc = (acc * x + c) % q
+    return acc
+
+
+def split_secret(
+    secret: int,
+    n_shares: int,
+    threshold: int,
+    *,
+    q: int = P256_ORDER,
+    rng=None,
+) -> list[tuple[int, int]]:
+    """Split ``secret`` into ``n_shares`` points of a random degree
+    ``threshold - 1`` polynomial with constant term ``secret``.
+
+    Any ``threshold`` shares reconstruct exactly; fewer reveal nothing
+    (every sub-threshold set is consistent with every possible secret).
+    ``rng``: optional ``random.Random``-like source for deterministic
+    tests; defaults to OS entropy.
+    """
+    if not (0 <= secret < q):
+        raise ValueError("secret must be a field element in [0, q)")
+    if not (1 <= threshold <= n_shares):
+        raise ValueError(f"need 1 <= threshold({threshold}) <= n_shares({n_shares})")
+    if n_shares >= q:  # unreachable for P-256 but keeps the math honest
+        raise ValueError("n_shares must be < field size")
+    draw = (lambda: rng.randrange(q)) if rng is not None else (lambda: secrets.randbelow(q))
+    coeffs = [secret] + [draw() for _ in range(threshold - 1)]
+    return [(x, _eval_poly(coeffs, x, q)) for x in range(1, n_shares + 1)]
+
+
+def reconstruct_secret(
+    shares: list[tuple[int, int]], *, q: int = P256_ORDER
+) -> int:
+    """Lagrange interpolation at 0 over the given shares.
+
+    Caller must supply at least ``threshold`` distinct shares; with fewer,
+    the result is a uniformly random-looking field element, not an error —
+    thresholdness is information-theoretic, not enforced here.
+    """
+    if not shares:
+        raise ValueError("no shares given")
+    xs = [x for x, _ in shares]
+    if len(set(xs)) != len(xs):
+        raise ValueError("duplicate share x-coordinates")
+    acc = 0
+    for i, (xi, yi) in enumerate(shares):
+        num, den = 1, 1
+        for j, (xj, _) in enumerate(shares):
+            if i == j:
+                continue
+            num = (num * (-xj)) % q
+            den = (den * (xi - xj)) % q
+        acc = (acc + yi * num * pow(den, -1, q)) % q
+    return acc

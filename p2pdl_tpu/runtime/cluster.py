@@ -37,16 +37,27 @@ class Node:
         self.node_id = node_id
         self.addr = addr
         self.port = port
-        self.running = False
         self.neighbors: list["Node"] = []
         self._delivered = threading.Event()
 
+    @property
+    def running(self) -> bool:
+        """Single source of truth: the cluster's stopped set (a second
+        boolean here would have to be kept in sync manually)."""
+        return self.node_id not in self.cluster._stopped
+
     # -- lifecycle (reference node/node.py:76-95) --
     def start(self) -> None:
-        self.running = True
+        """(Re-)join the cluster: eligible for sampling and consent again
+        (reference ``start()`` binds the listener socket)."""
+        self.cluster._stopped.discard(self.node_id)
 
     def stop(self) -> None:
-        self.running = False
+        """Go dark, like the reference's socket teardown (``node/node.py:
+        93-95``): a stopped node cannot consent to training, a round that
+        sampled it runs with its slot vacated (-1, shrunken participation),
+        and its delivery flag never sets. ``start()`` re-admits."""
+        self.cluster._stopped.add(self.node_id)
 
     def connect(self, other: "Node") -> None:
         """Record a neighbor (reference ``node/node.py:251-263``; its TCP
@@ -69,6 +80,12 @@ class Node:
 
     # -- training / testing (reference node/node.py:315-326) --
     def set_start_learning(self, rounds: int = 1, epochs: int = 5) -> None:
+        """Consent to train this round. On a stopped node this raises —
+        the reference's equivalent would enqueue onto a dead command loop
+        and hang its caller forever (``node/node.py:322-326`` after
+        ``stop()``); failing loudly is the honest version."""
+        if not self.running:
+            raise RuntimeError(f"node {self.node_id} is stopped")
         self.cluster._mark_trainer(self.node_id)
 
     def testing(self) -> dict[str, Any]:
@@ -87,6 +104,7 @@ class Cluster:
     def __init__(self, cfg: Config, base_port: int = 7001, **experiment_kwargs: Any) -> None:
         self.cfg = cfg
         self.experiment = Experiment(cfg, **experiment_kwargs)
+        self._stopped: set[int] = set()
         self.nodes = [Node(self, i, "127.0.0.1", base_port + i) for i in range(cfg.num_peers)]
         self._pending_trainers: set[int] = set()
         self._expected_trainers: Optional[list[int]] = None
@@ -108,8 +126,10 @@ class Cluster:
         run_now = False
         with self._lock:
             self._pending_trainers.add(node_id)
-            if self._expected_trainers is not None and self._pending_trainers >= set(
-                self._expected_trainers
+            # Stopped trainers can never consent — the round proceeds once
+            # every LIVE sampled trainer has (their slots get vacated).
+            if self._expected_trainers is not None and self._pending_trainers >= (
+                set(self._expected_trainers) - self._stopped
             ):
                 run_now = True
         if run_now:
@@ -123,11 +143,17 @@ class Cluster:
         if trainers is None:
             return
         # The cluster's consented roles, not the experiment's own sampling.
+        # A stopped node's slot runs vacant (-1): shrunken participation,
+        # exactly as if the peer failed before training (the reference's
+        # stop() tears the node down mid-experiment, ``node/node.py:93-95``).
+        trainers = [t if t not in self._stopped else -1 for t in trainers]
+        if all(t < 0 for t in trainers):
+            raise RuntimeError("every sampled trainer is stopped")
         record = self.experiment.run_round(trainers=trainers)
         self.last_record = record
         failed = set(record.brb_failed_peers or [])
         for node in self.nodes:
-            if node.node_id not in failed:
+            if node.node_id not in failed and node.node_id not in self._stopped:
                 node._delivered.set()
 
     def per_node_results(self, node_ids: Optional[list[int]] = None) -> list[dict[str, Any]]:
@@ -146,12 +172,16 @@ class Cluster:
         reference ``main.py:50-87`` collapsed into one call)."""
         if trainers is None:
             trainers = self.experiment.sample_roles().tolist()
+        if all(t in self._stopped for t in trainers):
+            raise RuntimeError("every sampled trainer is stopped")
         self._expected_trainers = trainers
         before = len(self.experiment.records)
         for node in self.nodes:
             node.reset_delivered_flag()
         for t in trainers:
-            self.nodes[t].set_start_learning(rounds=1, epochs=self.cfg.local_epochs)
+            # Stopped trainers cannot consent; their slots run vacant.
+            if t not in self._stopped:
+                self.nodes[t].set_start_learning(rounds=1, epochs=self.cfg.local_epochs)
         if len(self.experiment.records) == before:
             raise RuntimeError("round did not execute (trainer set mismatch)")
         return self.experiment.records[-1]

@@ -32,6 +32,7 @@ from p2pdl_tpu.data import make_federated_data
 from p2pdl_tpu.parallel import (
     build_eval_fn,
     build_round_fn,
+    build_gossip_trust_round_fns,
     build_trust_round_fns,
     init_peer_state,
     make_mesh,
@@ -233,15 +234,51 @@ class Experiment:
             pp_shards=cfg.pp_shards,
         )
         self.data = make_federated_data(cfg)
-        # Sync layouts with the trust plane on use the split (two-program)
-        # round so the BRB verdict gates the aggregate between the phases;
-        # everything else runs the fused single-program round.
+        # Secure aggregation keys: real ECDH key agreement over per-peer
+        # P-256 keypairs (protocol/secure_keys) — masks underivable from
+        # public state, unlike round 3's shared-experiment-key derivation
+        # (kept as secure_agg_keys="shared" for A/B benchmarking). Seeded
+        # from cfg.seed so checkpoint/resume stays bit-exact; Shamir shares
+        # of every private scalar are distributed at setup so a trainer
+        # dropping AFTER masking can have its orphaned masks reconstructed
+        # and cancelled (the BRB gate-out path in run_round).
+        self.secure_keyring = None
+        self._seed_mat = None
+        self._pair_seeds_dev = None
+        pair_seeds = None
+        if cfg.aggregator == "secure_fedavg" and cfg.secure_agg_keys == "ecdh":
+            from p2pdl_tpu.protocol.secure_keys import SecureAggKeyring
+
+            self.secure_keyring = SecureAggKeyring(cfg.num_peers, seed=cfg.seed)
+            # O(P^2/2) ECDH once per experiment (~1min at P=1024; a
+            # simulation artifact — deployed peers each do O(P) in
+            # parallel). Shares only matter where dropout recovery can run
+            # (the gated pipeline), so don't pay Shamir on the fused path.
+            pair_seeds = self.secure_keyring.seed_matrix()
+            self._seed_mat = pair_seeds
+        # Layouts with the trust plane on use a split (two-program) round so
+        # the BRB verdict lands BETWEEN the phases: sync layouts gate the
+        # aggregate, the gossip layout gates the mixing weights (an
+        # unverified peer's params never enter any honest peer's round-r
+        # mix). Everything else runs the fused single-program round.
         self._gated = cfg.brb_enabled and params_layout(cfg) == "sync"
+        self._gated_gossip = cfg.brb_enabled and params_layout(cfg) == "peer"
+        self.round_fn = None
         if self._gated:
-            self.train_fn, self.agg_fn = build_trust_round_fns(cfg, self.mesh, attack=attack)
-            self.round_fn = None
+            if self.secure_keyring is not None:
+                self.secure_keyring.distribute_shares()
+                self._pair_seeds_dev = jnp.asarray(pair_seeds)
+            self.train_fn, self.agg_fn = build_trust_round_fns(
+                cfg, self.mesh, attack=attack, pair_seeds=pair_seeds
+            )
+        elif self._gated_gossip:
+            self.train_fn, self.mix_fn = build_gossip_trust_round_fns(
+                cfg, self.mesh, attack=attack
+            )
         else:
-            self.round_fn = build_round_fn(cfg, self.mesh, attack=attack)
+            self.round_fn = build_round_fn(
+                cfg, self.mesh, attack=attack, pair_seeds=pair_seeds
+            )
         self.eval_fn = build_eval_fn(cfg)
         self.metrics = MetricsLogger(log_path)
         self.trust = _TrustPlane(cfg, byz_ids) if cfg.brb_enabled else None
@@ -341,6 +378,17 @@ class Experiment:
                     f"explicit trainer list has {len(trainers)} entries, "
                     f"config expects trainers_per_round={self.cfg.trainers_per_round}"
                 )
+            if (trainers < 0).any() and self.cfg.aggregator not in (
+                "fedavg", "secure_fedavg", "gossip"
+            ):
+                # The gathered/blockwise robust reducers index their full
+                # [T] update matrix; a traced -1 would WRAP to peer P-1 and
+                # feed a phantom update into the reducer (sample_roles
+                # never pads -1 for them — guard explicit lists too).
+                raise ValueError(
+                    "vacant (-1) trainer slots require a mean-family "
+                    "aggregator; robust reducers need their full update matrix"
+                )
         # -1 entries are vacancy padding for a shrunken round (see
         # sample_roles); the device program consumes the padded vector, the
         # host plane (trust, metrics, records) only the live peers.
@@ -375,8 +423,57 @@ class Experiment:
                     # remain observational -> next-round sampling exclusion.
                     gated = trainers
             with self.profiler.phase("agg"):
+                # masked_idx = the PRE-gate trainer vector: under
+                # secure_fedavg every sampled trainer masked its delta
+                # before the BRB verdict landed, so the aggregate must
+                # cancel the orphaned masks gated-out trainers left behind
+                # (residual_mask_sum; Shamir recovery in a deployment).
                 self.state = self.agg_fn(
-                    self.state, delta, new_opt, jnp.asarray(gated, jnp.int32), mask_key
+                    self.state, delta, new_opt, jnp.asarray(gated, jnp.int32),
+                    mask_key, masked_idx=jnp.asarray(trainers, jnp.int32),
+                    seeds=self._pair_seeds_dev,
+                )
+            if self.secure_keyring is not None and brb_excluded:
+                # Disclosure hygiene: a gated-out trainer's scalar became
+                # reconstructible (the recovery flow's premise), so rotate
+                # its key before it can mask again — old shares say nothing
+                # about the new scalar, restoring forward secrecy
+                # (protocol/secure_keys.py disclosure-scope note). Runtime
+                # seeds: no recompile. Rotate into a COPY: on the CPU
+                # backend jnp.asarray zero-copies aligned numpy buffers, so
+                # mutating the live matrix would corrupt the still-in-flight
+                # async aggregate that is reading it.
+                new_mat = self._seed_mat.copy()
+                for pid in brb_excluded:
+                    self.secure_keyring.rotate(pid, mat=new_mat)
+                self._seed_mat = new_mat
+                self._pair_seeds_dev = jnp.asarray(new_mat)
+        elif self._gated_gossip:
+            # BRB-gated gossip: train -> digest+BRB -> verdict-masked mix.
+            # Every peer commits to its own PRE-mix delta; an unverified
+            # peer's weight is zeroed in every neighbor's mixing row, so its
+            # (possibly corrupted) params never enter any honest peer's
+            # round-r mix — exclusion is in-round, not one round late.
+            with self.profiler.phase("round"):
+                attacked, new_opt, losses_dev, delta = self.train_fn(
+                    self.state, self.x, self.y, self.byz_gate, mask_key
+                )
+                train_loss = float(np.mean(np.asarray(losses_dev)))
+            with self.profiler.phase("brb"):
+                # Gossip has no roles: EVERY peer mixes, so every peer must
+                # commit its delta — the verdict covers the full peer set
+                # (a peer outside the committee would otherwise be
+                # unverifiable yet zero-weighted out of the mix).
+                gossip_live = np.arange(self.cfg.num_peers)
+                brb_delivered, brb_failed, brb_excluded, verified, msgs, nbytes = (
+                    self._run_trust_plane(r, gossip_live, delta)
+                )
+                verdict = np.isin(
+                    gossip_live, np.asarray(verified)
+                ).astype(np.float32)
+            with self.profiler.phase("agg"):
+                self.state = self.mix_fn(
+                    self.state, attacked, new_opt, jnp.asarray(verdict)
                 )
         else:
             with self.profiler.phase("round"):
@@ -397,16 +494,6 @@ class Experiment:
                 if self.cfg.aggregator != "gossip":
                     losses = losses[live]
                 train_loss = float(np.mean(losses))
-
-            if self.trust is not None:
-                # Gossip with the trust plane: the ring mix is in-band, so
-                # BRB here is observational — each peer commits to its own
-                # PRE-mix delta (what it contributed to the ring); delivery
-                # accounting feeds next-round cooldown exclusion.
-                with self.profiler.phase("brb"):
-                    brb_delivered, brb_failed, brb_excluded, _, msgs, nbytes = (
-                        self._run_trust_plane(r, live, m["delta"])
-                    )
 
         with self.profiler.phase("eval"):
             ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)

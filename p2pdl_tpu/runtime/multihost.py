@@ -276,6 +276,15 @@ class MultiHostTrustPlane:
     Message handling is single-threaded: transport threads only enqueue;
     ``_pump`` drains on the caller's thread, so broadcaster state needs no
     locks (SURVEY §5 race-safety stance).
+
+    Every frame a host ACTS ON is authenticated: BRB messages carry their
+    per-peer ECDSA signatures inside the Bracha state machine, and the
+    host-level ``report``/``decision`` frames are signed with per-host
+    identity keys (exchanged alongside the peer PEMs) — unsigned or
+    mis-signed frames are dropped, and the decision additionally binds to
+    the coordinator's key, so no host can forge the global verdict
+    (the reference signs every acted-on payload too,
+    ``utils/broadcast.py:19-30``; round 3 shipped these frames plain).
     """
 
     def __init__(
@@ -292,7 +301,10 @@ class MultiHostTrustPlane:
             generate_key_pair,
             public_key_from_pem,
             public_key_pem,
+            sign_data,
         )
+
+        self._sign_data = sign_data
 
         self.cfg = cfg
         self.topo = topo
@@ -319,11 +331,52 @@ class MultiHostTrustPlane:
             self.key_server.register_key(pid, pub)
             self._pems[pid] = public_key_pem(pub).decode()
             self.broadcasters[pid] = Broadcaster(brb_cfg, pid, self.key_server, priv)
+        # Host identity key: signs the host-level protocol frames (report,
+        # decision). Round 3 shipped these as PLAIN JSON — any process that
+        # could reach a host's control port could forge the coordinator's
+        # decision and admit an arbitrary trainer set (the reference, for
+        # all its flaws, signs every payload it acts on,
+        # ``utils/broadcast.py:19-30``). Host pubkeys ride the same
+        # key-exchange phase as peer keys; the directory reuses KeyServer's
+        # substitution guard.
+        self._host_priv, host_pub = generate_key_pair()
+        self._host_pem = public_key_pem(host_pub).decode()
+        self.host_keys = KeyServer()
+        self.host_keys.register_key(topo.process_id, host_pub)
         self._reports: dict[int, dict] = {}
         self._decision: Optional[dict] = None
         self._acks: set[int] = set()
 
     # -- wire helpers ------------------------------------------------------
+    @staticmethod
+    def _canonical(obj: dict) -> bytes:
+        """The signed byte view of a host frame: sorted-key JSON of
+        everything but the signature itself. Canonical (dict order cannot
+        perturb it), and unlike the reference's pickle-of-object signing
+        (``utils/broadcast.py:19-21``) it never deserializes untrusted
+        bytes into live objects."""
+        return json.dumps(
+            {k: v for k, v in obj.items() if k != "sig"},
+            sort_keys=True, separators=(",", ":"),
+        ).encode()
+
+    def _sign_frame(self, obj: dict) -> dict:
+        sig = self._sign_data(self._host_priv, self._canonical(obj))
+        return {**obj, "sig": base64.b64encode(sig).decode()}
+
+    def _verify_frame(self, obj: dict) -> bool:
+        """True iff the frame's ``sig`` verifies under the claimed host's
+        registered identity key. Missing key, missing sig, or bad sig all
+        fail CLOSED — the frame is dropped, never acted on."""
+        sig_b64 = obj.get("sig")
+        if sig_b64 is None or "host" not in obj:
+            return False
+        try:
+            sig = base64.b64decode(sig_b64)
+        except (ValueError, TypeError):
+            return False
+        return self.host_keys.verify(int(obj["host"]), sig, self._canonical(obj))
+
     def _send_host(self, h: int, obj: dict) -> None:
         data = json.dumps(obj).encode()
         if h == self.topo.process_id:
@@ -356,6 +409,14 @@ class MultiHostTrustPlane:
         if kind == "keys":
             for pid_s, pem in obj.get("keys", {}).items():
                 self.key_server.register_key(int(pid_s), self._from_pem(pem.encode()))
+            # Host identity key rides the same announcement (trust-on-first-
+            # use into a substitution-guarded directory, like peer keys —
+            # the PKI bootstrap assumption is shared, reference
+            # ``utils/crypto.py:7-40``).
+            if "host_key" in obj and "host" in obj:
+                self.host_keys.register_key(
+                    int(obj["host"]), self._from_pem(obj["host_key"].encode())
+                )
         elif kind == "brb":
             msg = brb_from_wire(base64.b64decode(obj["w"]))
             if msg is None:
@@ -366,9 +427,17 @@ class MultiHostTrustPlane:
         elif kind == "keys_ack":
             self._acks.add(int(obj["host"]))
         elif kind == "report":
-            self._reports[int(obj["host"])] = obj
+            # Unsigned/forged reports are dropped: a spoofed report could
+            # fabricate delivery verdicts or digest attestations for peers
+            # it does not own.
+            if self._verify_frame(obj):
+                self._reports[int(obj["host"])] = obj
         elif kind == "decision":
-            self._decision = obj
+            # The decision gates the aggregate on every host — accept it
+            # only under the COORDINATOR's key (host 0). A frame that
+            # merely claims host 0 without its signature fails closed.
+            if int(obj.get("host", -1)) == 0 and self._verify_frame(obj):
+                self._decision = obj
 
     def _pump(self, deadline: float, done) -> bool:
         while True:
@@ -397,9 +466,13 @@ class MultiHostTrustPlane:
             "t": "keys",
             "host": self.topo.process_id,
             "keys": {str(p): pem for p, pem in self._pems.items()},
+            "host_key": self._host_pem,
         }
         deadline = time.monotonic() + timeout_s
-        done = lambda: len(self.key_server) == self.cfg.num_peers  # noqa: E731
+        done = lambda: (  # noqa: E731
+            len(self.key_server) == self.cfg.num_peers
+            and len(self.host_keys) == self.topo.num_processes
+        )
         full = False
         while time.monotonic() < deadline:
             self._broadcast_hosts(msg)
@@ -491,14 +564,14 @@ class MultiHostTrustPlane:
             payloads[str(t)] = (
                 base64.b64encode(sample).decode() if sample is not None else None
             )
-        report = {
+        report = self._sign_frame({
             "t": "report",
             "host": self.topo.process_id,
             "round": round_idx,
             "delivered": delivered,
             "payloads": payloads,
             "attest": {str(t): local_digests[t].hex() for t in my_trainers},
-        }
+        })
         decision_deadline = time.monotonic() + self.cfg.round_timeout_s
         if self.topo.is_coordinator:
             self._send_host(0, report)
@@ -511,8 +584,10 @@ class MultiHostTrustPlane:
             )
             decision = self._decide(round_idx, trainer_ids)
             self._broadcast_hosts(
-                {"t": "decision", "host": self.topo.process_id,
-                 "round": round_idx, **decision}
+                self._sign_frame(
+                    {"t": "decision", "host": self.topo.process_id,
+                     "round": round_idx, **decision}
+                )
             )
             # Apply the freshly-computed decision directly: report collection
             # may have exhausted decision_deadline, and the coordinator must

@@ -189,6 +189,7 @@ RESUME_COMPATIBLE_FIELDS = (
     "robust_impl",
     "seq_shards",
     "secure_agg_neighbors",
+    "secure_agg_keys",
 )
 
 # Bumped when the PeerState pytree layout changes (v2: sync-layout params

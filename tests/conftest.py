@@ -13,9 +13,25 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# The CPU AOT loader logs a benign machine-feature mismatch (XLA's
+# prefer-no-scatter/gather pseudo-features, same machine both sides) at
+# ERROR severity on EVERY persistent-cache hit — hundreds of 20-line
+# blocks per warm run — so XLA's C++ log is silenced by default.
+# Tradeoff (deliberate): real XLA C++ errors are hidden too. When
+# debugging an unexplained numeric failure or suspecting cache
+# misexecution, re-run with TF_CPP_MIN_LOG_LEVEL=0 (setdefault means the
+# env wins) or delete .jax_cache.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# Persistent compilation cache: the suite is dominated by shard_map/pjit
+# compile times (24.5 min cold on this host); warm reruns skip recompiling
+# anything that took >0.5s. Safe across processes (content-addressed files),
+# so pytest-xdist workers share it.
+jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 # The image's sitecustomize may import jax with JAX_PLATFORMS pinned to a TPU
 # backend before this conftest runs; backends initialize lazily, so overriding

@@ -21,6 +21,7 @@ def main() -> None:
     tp_ports = [int(p) for p in sys.argv[4].split(",")]
     assert len(tp_ports) == nproc, (tp_ports, nproc)
     equivocate = "--equivocate" in sys.argv
+    forge_decision = "--forge-decision" in sys.argv
 
     import jax
 
@@ -87,6 +88,16 @@ def main() -> None:
         # Generous window: the hosts reach the exchange at different times
         # (each binds its listener only after its own jit compile).
         tp.exchange_keys(timeout_s=120.0)
+        if forge_decision and pid == nproc - 1:
+            # Attack injection: a non-coordinator claims the coordinator's
+            # identity and broadcasts a decision admitting EVERY trainer
+            # (including the equivocator the honest verdict excludes). The
+            # frame carries no valid host-0 signature, so every host must
+            # drop it and wait for the real decision.
+            tp._broadcast_hosts({
+                "t": "decision", "host": 0, "round": 0,
+                "failed": [], "verified": [int(t) for t in trainers],
+            })
         failed, verified = tp.run_round(
             0,
             [int(t) for t in trainers],

@@ -17,6 +17,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.multihost
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
 
@@ -113,3 +115,16 @@ def test_four_process_round_end_to_end():
         assert r["local_loss_finite"]
     checksums = {r["checksum"] for r in outs}
     assert len(checksums) == 1, f"hosts diverged: {checksums}"
+
+
+def test_forged_decision_rejected():
+    """Host frames are signed (per-host ECDSA identity keys exchanged with
+    the peer PEMs): a non-coordinator broadcasting an UNSIGNED decision that
+    claims host 0 and admits the equivocating trainer must be dropped on
+    every host — the verdict fails closed to the coordinator's real, signed
+    decision, and the aggregate still excludes the equivocator."""
+    a, b = _run_workers(("--equivocate", "--forge-decision"))
+    for r in (a, b):
+        assert r["verified"] == [2, 5, 7]
+        assert 0 not in r["verified"]
+    assert a["checksum"] == b["checksum"]

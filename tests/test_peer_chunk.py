@@ -42,7 +42,21 @@ def _run_one_round(cfg, mesh, data, attack="none", byz=None):
 
 @pytest.mark.parametrize(
     "aggregator,attack",
-    [("fedavg", "none"), ("fedavg", "sign_flip"), ("secure_fedavg", "none")],
+    [
+        ("fedavg", "none"),
+        ("fedavg", "sign_flip"),
+        # noise: per-global-peer-id draw keys make the draws layout-
+        # invariant, so chunked == unchunked holds for the stochastic
+        # attack too (round-3 limitation removed).
+        ("fedavg", "noise"),
+        # alie: the adaptive collusion streams its honest moments through
+        # the chunk scan (raw-moment accumulators) and lands the envelope
+        # once post-psum — equal to the unchunked body up to raw-vs-
+        # centered variance rounding.
+        ("fedavg", "alie"),
+        ("secure_fedavg", "none"),
+        ("secure_fedavg", "alie"),
+    ],
 )
 def test_chunked_round_matches_general(mesh8, aggregator, attack):
     base = Config(
@@ -57,17 +71,21 @@ def test_chunked_round_matches_general(mesh8, aggregator, attack):
         compute_dtype="float32",
     )
     data = make_federated_data(base, eval_samples=32)
-    byz = jnp.zeros(16).at[2].set(1.0) if attack != "none" else None
+    byz = jnp.zeros(16).at[2].set(1.0).at[9].set(1.0) if attack != "none" else None
     want = _run_one_round(base, mesh8, data, attack=attack, byz=byz)
     # peer_chunk=1 (extreme) and 2 (interior) both equal the full vmap.
     for chunk in (1, 2):
         got = _run_one_round(
             base.replace(peer_chunk=chunk), mesh8, data, attack=attack, byz=byz
         )
+        # alie's variance is raw-moment in the streamed body vs centered in
+        # the unchunked one: identical in exact arithmetic, ~1e-5 apart in
+        # float32 on lr-scaled deltas.
+        tol = 5e-5 if attack == "alie" else 1e-5
         for a, b in zip(jax.tree.leaves(got[0]), jax.tree.leaves(want[0])):
-            np.testing.assert_allclose(a, b, atol=1e-5)
+            np.testing.assert_allclose(a, b, atol=tol)
         np.testing.assert_allclose(got[1], want[1], atol=1e-6)
-        np.testing.assert_allclose(got[2], want[2], atol=1e-6)
+        np.testing.assert_allclose(got[2], want[2], atol=1e-5)
 
 
 def test_chunked_round_large_peer_count(mesh8):

@@ -109,3 +109,151 @@ def test_non_trainer_unmasked():
     ids = jnp.asarray([0, 1], jnp.int32)
     out = apply_masks(d, base, jnp.int32(3), ids, jnp.bool_(False))
     np.testing.assert_array_equal(np.asarray(out["w"]), np.ones(8))
+
+
+# ---- ECDH seed-keyed masks + dropout residual ------------------------
+
+from p2pdl_tpu.ops.secure_agg import residual_mask_sum  # noqa: E402
+from p2pdl_tpu.protocol.secure_keys import SecureAggKeyring  # noqa: E402
+
+
+def _seed_mat(p, seed=21):
+    return jnp.asarray(SecureAggKeyring(p, seed=seed).seed_matrix())
+
+
+def test_seed_keyed_masks_cancel_and_hide():
+    """ECDH-derived pair seeds (the driver's default key path): masks cancel
+    in the sum and hide individual updates, full graph and k-ring alike."""
+    t = 6
+    deltas = _deltas(t, seed=8)
+    seeds = _seed_mat(t)
+    ids = jnp.arange(t, dtype=jnp.int32)
+    for k in (0, 4):
+        masked = jax.vmap(
+            lambda d, pid: apply_masks(
+                {"w": d}, None, pid, ids, jnp.bool_(True),
+                neighbors=k, pair_seeds=seeds, round_idx=jnp.int32(3),
+            )
+        )(deltas, ids)["w"]
+        np.testing.assert_allclose(
+            np.asarray(masked.sum(0)), np.asarray(deltas.sum(0)), rtol=1e-4, atol=1e-4
+        )
+        diff = np.abs(np.asarray(masked) - np.asarray(deltas)).mean(axis=1)
+        assert (diff > 0.1).all(), f"masks too weak (k={k}): {diff}"
+
+
+def test_seed_keyed_masks_vary_by_round():
+    """Folding the round index means masks never repeat across rounds (a
+    repeated mask lets two rounds' masked updates be differenced)."""
+    seeds = _seed_mat(4)
+    ids = jnp.arange(4, dtype=jnp.int32)
+    tree = {"w": jnp.zeros((16,))}
+    m0 = pairwise_mask(None, jnp.int32(1), ids, tree, pair_seeds=seeds, round_idx=jnp.int32(0))
+    m1 = pairwise_mask(None, jnp.int32(1), ids, tree, pair_seeds=seeds, round_idx=jnp.int32(1))
+    assert np.abs(np.asarray(m0["w"]) - np.asarray(m1["w"])).max() > 0.1
+
+
+def test_dropout_residual_restores_sum_full_graph():
+    """A trainer masks, then drops (BRB gate-out): the gated sum carries its
+    partners' orphaned masks; subtracting residual_mask_sum restores the
+    honest survivors' unmasked sum exactly (to float tolerance)."""
+    t = 6
+    deltas = _deltas(t, seed=9)
+    seeds = _seed_mat(t)
+    masked_ids = jnp.arange(t, dtype=jnp.int32)     # everyone masked
+    r = jnp.int32(5)
+    masked = jax.vmap(
+        lambda d, pid: apply_masks(
+            {"w": d}, None, pid, masked_ids, jnp.bool_(True),
+            pair_seeds=seeds, round_idx=r,
+        )
+    )(deltas, masked_ids)["w"]
+    # Peers 2 and 4 drop after masking: only survivors' masked deltas summed.
+    gated = jnp.asarray([0, 1, -1, 3, -1, 5], jnp.int32)
+    surv = np.asarray([0, 1, 3, 5])
+    raw_sum = np.asarray(masked)[surv].sum(0)
+    honest = np.asarray(deltas)[surv].sum(0)
+    # Orphaned masks make the naive gated sum wrong...
+    assert np.abs(raw_sum - honest).max() > 0.1
+    resid = residual_mask_sum(
+        {"w": jnp.zeros(deltas.shape[1])}, masked_ids, gated,
+        pair_seeds=seeds, round_idx=r,
+    )["w"]
+    np.testing.assert_allclose(raw_sum - np.asarray(resid), honest, rtol=1e-4, atol=1e-4)
+
+
+def test_dropout_residual_restores_sum_k_ring():
+    """Same recovery under the Bell k-ring pairing — partner derivation in
+    the residual must match mask-time ranks over the PRE-gate vector."""
+    t = 9
+    k = 4
+    deltas = _deltas(t, seed=10)
+    seeds = _seed_mat(t, seed=22)
+    masked_ids = jnp.arange(t, dtype=jnp.int32)
+    r = jnp.int32(2)
+    masked = jax.vmap(
+        lambda d, pid: apply_masks(
+            {"w": d}, None, pid, masked_ids, jnp.bool_(True),
+            neighbors=k, pair_seeds=seeds, round_idx=r,
+        )
+    )(deltas, masked_ids)["w"]
+    gated = jnp.asarray([0, 1, 2, -1, 4, 5, -1, 7, 8], jnp.int32)
+    surv = np.asarray([0, 1, 2, 4, 5, 7, 8])
+    raw_sum = np.asarray(masked)[surv].sum(0)
+    honest = np.asarray(deltas)[surv].sum(0)
+    resid = residual_mask_sum(
+        {"w": jnp.zeros(deltas.shape[1])}, masked_ids, gated,
+        neighbors=k, pair_seeds=seeds, round_idx=r,
+    )["w"]
+    np.testing.assert_allclose(raw_sum - np.asarray(resid), honest, rtol=1e-4, atol=1e-4)
+
+
+def test_residual_zero_when_nobody_drops():
+    t = 5
+    seeds = _seed_mat(t)
+    ids = jnp.arange(t, dtype=jnp.int32)
+    resid = residual_mask_sum(
+        {"w": jnp.zeros(8)}, ids, ids, pair_seeds=seeds, round_idx=jnp.int32(0)
+    )["w"]
+    np.testing.assert_array_equal(np.asarray(resid), np.zeros(8))
+
+
+def test_reconstructed_seeds_cancel_orphans():
+    """End-to-end protocol loop: the dropped peer's seed ROW reconstructed
+    from survivor Shamir shares — NOT the live matrix — feeds the residual,
+    and recovery still lands exactly on the honest sum. This is the flow a
+    real deployment runs (the aggregator never held the dropped seeds)."""
+    t = 7
+    kr = SecureAggKeyring(t, seed=31)
+    kr.distribute_shares()
+    full = kr.seed_matrix()
+    deltas = _deltas(t, seed=12)
+    masked_ids = jnp.arange(t, dtype=jnp.int32)
+    r = jnp.int32(1)
+    masked = jax.vmap(
+        lambda d, pid: apply_masks(
+            {"w": d}, None, pid, masked_ids, jnp.bool_(True),
+            pair_seeds=jnp.asarray(full), round_idx=r,
+        )
+    )(deltas, masked_ids)["w"]
+    dropped = 3
+    gated = jnp.asarray([0, 1, 2, -1, 4, 5, 6], jnp.int32)
+    surv = np.asarray([0, 1, 2, 4, 5, 6])
+    # Aggregator's view: it only ever needed row `dropped` of the matrix,
+    # and obtains it via Shamir reconstruction from 4 (= threshold) holders.
+    row = kr.reconstruct_seeds_for_dropped(dropped, [0, 1, 4, 6])
+    recon = np.zeros_like(full)
+    # Survivor-side seeds the aggregator legitimately has (each survivor
+    # reveals its own pairs with the dropped peer is NOT needed — the
+    # reconstructed row covers both directions by symmetry).
+    recon[dropped, :, :] = row
+    recon[:, dropped, :] = row
+    # Survivor-survivor pairs cancel in the sum, so the residual only reads
+    # (survivor, dropped) entries — the reconstructed ones.
+    resid = residual_mask_sum(
+        {"w": jnp.zeros(deltas.shape[1])}, masked_ids, gated,
+        pair_seeds=jnp.asarray(recon), round_idx=r,
+    )["w"]
+    raw_sum = np.asarray(masked)[surv].sum(0)
+    honest = np.asarray(deltas)[surv].sum(0)
+    np.testing.assert_allclose(raw_sum - np.asarray(resid), honest, rtol=1e-4, atol=1e-4)

@@ -1,0 +1,144 @@
+"""ECDH pairwise seeds + Shamir dropout recovery (protocol/secure_keys,
+protocol/shamir) — the host half of secure aggregation's key story.
+
+The reference has no masking (updates are plaintext pickle, reference
+``utils/broadcast.py:8-37``); these tests pin the protocol properties the
+TPU engine's mask PRF relies on: ECDH symmetry, determinism, domain
+separation, threshold reconstruction, and that a dropped peer's seed row
+reconstructed from survivor shares matches the row the live peer derived.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from p2pdl_tpu.protocol import shamir
+from p2pdl_tpu.protocol.secure_keys import SecureAggKeyring
+
+
+# ---- Shamir ----------------------------------------------------------
+
+
+def test_shamir_roundtrip():
+    rng = random.Random(0)
+    secret = rng.randrange(shamir.P256_ORDER)
+    shares = shamir.split_secret(secret, 7, 4, rng=rng)
+    assert shamir.reconstruct_secret(shares[:4]) == secret
+    # Any subset of threshold size works, not just a prefix.
+    assert shamir.reconstruct_secret([shares[1], shares[6], shares[0], shares[3]]) == secret
+    # All shares also reconstruct (degree < len(points) interpolation).
+    assert shamir.reconstruct_secret(shares) == secret
+
+
+def test_shamir_below_threshold_reveals_nothing_consistent():
+    rng = random.Random(1)
+    secret = 12345
+    shares = shamir.split_secret(secret, 5, 3, rng=rng)
+    # 2 of 3 shares interpolate to SOME field element, but not the secret
+    # (information-theoretically they are consistent with every secret; the
+    # interpolation of a deficient set almost surely misses the real one).
+    wrong = shamir.reconstruct_secret(shares[:2])
+    assert wrong != secret
+
+
+def test_shamir_validation():
+    with pytest.raises(ValueError):
+        shamir.split_secret(-1, 3, 2)
+    with pytest.raises(ValueError):
+        shamir.split_secret(1, 3, 4)  # threshold > n
+    with pytest.raises(ValueError):
+        shamir.reconstruct_secret([])
+    with pytest.raises(ValueError):
+        shamir.reconstruct_secret([(1, 5), (1, 6)])  # duplicate x
+
+
+# ---- ECDH keyring ----------------------------------------------------
+
+
+def test_pair_seed_symmetric_and_deterministic():
+    kr = SecureAggKeyring(6, seed=7)
+    for i in range(6):
+        for j in range(6):
+            if i == j:
+                continue
+            assert kr.pair_seed(i, j) == kr.pair_seed(j, i)
+    # Deterministic from (seed, ids): a rebuilt keyring derives the same
+    # seeds — what makes checkpoint/resume bit-exact with masking on.
+    kr2 = SecureAggKeyring(6, seed=7)
+    assert kr.pair_seed(2, 5) == kr2.pair_seed(2, 5)
+    # Different experiment seed -> different key material.
+    kr3 = SecureAggKeyring(6, seed=8)
+    assert kr.pair_seed(2, 5) != kr3.pair_seed(2, 5)
+
+
+def test_seed_matrix_shape_symmetry_distinctness():
+    kr = SecureAggKeyring(8, seed=3)
+    mat = kr.seed_matrix()
+    assert mat.shape == (8, 8, 2) and mat.dtype == np.uint32
+    assert (mat == mat.transpose(1, 0, 2)).all()
+    assert (mat[np.arange(8), np.arange(8)] == 0).all()
+    # Off-diagonal pair seeds are pairwise distinct (64-bit collisions at
+    # P=8 would indicate broken domain separation, not chance).
+    off = {tuple(mat[i, j]) for i in range(8) for j in range(i + 1, 8)}
+    assert len(off) == 28
+
+
+def test_dropout_reconstruction_matches_live_row():
+    kr = SecureAggKeyring(7, seed=11)
+    kr.distribute_shares(rng=random.Random(0))
+    # Peer 3 drops; any honest-majority subset of survivors suffices.
+    holders = [0, 1, 4, 6]  # threshold = 7//2 + 1 = 4
+    row = kr.reconstruct_seeds_for_dropped(3, holders)
+    expect = kr.seed_matrix()[3]
+    assert (row == expect).all()
+
+
+def test_dropout_reconstruction_needs_threshold():
+    kr = SecureAggKeyring(7, seed=11)
+    kr.distribute_shares(rng=random.Random(0))
+    with pytest.raises(ValueError):
+        kr.reconstruct_seeds_for_dropped(3, [0, 1, 4])  # 3 < threshold 4
+    with pytest.raises(RuntimeError):
+        SecureAggKeyring(4, seed=1).reconstruct_seeds_for_dropped(0, [1, 2, 3])
+
+
+def test_entropy_mode_differs_across_instances():
+    a = SecureAggKeyring(3, seed=None)
+    b = SecureAggKeyring(3, seed=None)
+    assert a.pair_seed(0, 1) != b.pair_seed(0, 1)
+
+
+def test_rotate_restores_forward_secrecy():
+    """After rotation the old shares reconstruct the OLD scalar only: the
+    new seeds differ, the refreshed matrix row matches live derivation, and
+    fresh shares reconstruct the NEW row — a re-joining peer masks with
+    secrecy the pre-drop reconstruction says nothing about."""
+    kr = SecureAggKeyring(6, seed=5)
+    kr.distribute_shares(rng=random.Random(1))
+    mat = kr.seed_matrix()
+    old_row = mat[2].copy()
+    old_shares = [kr.share_of(2, h) for h in range(6)]
+    kr.rotate(2, mat=mat, rng=random.Random(2))
+    # New pair seeds everywhere off-diagonal; matrix updated symmetrically.
+    assert (mat[2, 3] != old_row[3]).any()
+    assert (mat[2] == kr.seed_matrix()[2]).all()
+    assert (mat[:, 2] == mat[2]).all()
+    # Old shares are stale: they reconstruct a scalar whose seeds are the
+    # OLD ones, not the rotated ones.
+    from p2pdl_tpu.protocol import shamir as _sh
+    from cryptography.hazmat.primitives.asymmetric import ec as _ec
+    old_scalar = _sh.reconstruct_secret(old_shares[:4])
+    old_priv = _ec.derive_private_key(old_scalar, _ec.SECP256R1())
+    stale = SecureAggKeyring.pair_seed_from(old_priv, kr.public_keys[3], 2, 3)
+    assert tuple(mat[2, 3]) != stale
+    # Fresh shares reconstruct the NEW row.
+    row = kr.reconstruct_seeds_for_dropped(2, [0, 1, 4, 5])
+    assert (row == mat[2]).all()
+
+
+def test_rotate_entropy_mode():
+    kr = SecureAggKeyring(4, seed=None)
+    before = kr.pair_seed(1, 2)
+    kr.rotate(1)
+    assert kr.pair_seed(1, 2) != before

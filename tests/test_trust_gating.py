@@ -195,3 +195,123 @@ def test_robust_reducer_keeps_full_matrix_under_brb(mesh8):
     record = exp.run_round()
     assert record.brb_excluded_trainers == []
     assert np.isfinite(record.train_loss)
+
+
+@pytest.mark.parametrize("keys_mode", ["ecdh", "shared"])
+def test_secure_gated_round_matches_plain_when_all_verify(mesh8, keys_mode):
+    """secure_fedavg under the BRB gate with zero dropouts: pre-gate masking
+    cancels pair-for-pair, the residual term is identically zero, and the
+    trajectory matches plain fedavg to float tolerance — for both the ECDH
+    keyring (default) and the legacy shared-key derivation."""
+    cfg = CFG.replace(
+        brb_enabled=True, aggregator="secure_fedavg", secure_agg_keys=keys_mode
+    )
+    exp, rec = _params_after_round(cfg, TRAINERS, mesh8)
+    assert rec.brb_excluded_trainers == []
+    expected, _ = _params_after_round(CFG, TRAINERS, mesh8)
+    _assert_trees_close(exp.state.params, expected.state.params, atol=1e-4)
+
+
+@pytest.mark.parametrize("keys_mode", ["ecdh", "shared"])
+def test_secure_dropout_masks_recovered(mesh8, keys_mode):
+    """The Bonawitz dropout scenario, end to end through the driver: a
+    trainer MASKS its delta (pre-gate), then drops (its broadcast never
+    delivers, BRB gates it out). Its surviving partners' deltas carry
+    orphaned masks; the aggregate cancels them via residual_mask_sum (seeds
+    Shamir-reconstructible in deployment — test_secure_keys closes that
+    loop) and must equal the plain round with the victim vacated."""
+    victim = 3
+    cfg = CFG.replace(
+        brb_enabled=True, aggregator="secure_fedavg", secure_agg_keys=keys_mode
+    )
+    exp = Experiment(cfg)
+    exp.trust.hub.drop = lambda src, dst, data: src == victim
+    record = exp.run_round(trainers=np.asarray(TRAINERS))
+    assert record.brb_excluded_trainers == [victim]
+    expected, _ = _params_after_round(
+        CFG, [t if t != victim else -1 for t in TRAINERS], mesh8
+    )
+    _assert_trees_close(exp.state.params, expected.state.params, atol=1e-4)
+
+
+def test_secure_dropout_uncorrected_sum_is_wrong(mesh8):
+    """Sanity: the orphaned masks are NOT negligible — without the residual
+    correction the gated secure aggregate diverges from the honest one (this
+    is what makes test_secure_dropout_masks_recovered meaningful)."""
+    from p2pdl_tpu.ops.secure_agg import residual_mask_sum
+
+    victim = 3
+    cfg = CFG.replace(brb_enabled=True, aggregator="secure_fedavg")
+    exp = Experiment(cfg)
+    gated = np.asarray([t if t != victim else -1 for t in TRAINERS])
+    resid = residual_mask_sum(
+        jax.tree.map(lambda p: jnp.zeros_like(p), exp.state.params),
+        jnp.asarray(TRAINERS, jnp.int32),
+        jnp.asarray(gated, jnp.int32),
+        pair_seeds=jnp.asarray(exp.secure_keyring.seed_matrix()),
+        round_idx=jnp.int32(0),
+    )
+    total = sum(float(np.abs(np.asarray(l)).sum()) for l in jax.tree.leaves(resid))
+    assert total > 1.0, f"residual unexpectedly small: {total}"
+
+
+def test_gossip_equivocator_never_enters_honest_mix(mesh8):
+    """In-round gossip gating (round-3 weakness removed): a peer whose
+    broadcast never delivers is zero-weighted in EVERY neighbor's mixing
+    row in the same round. Proof of non-consumption: honest peers' post-
+    round params are bit-identical whether or not the excluded peer's
+    update was wildly corrupted — the corruption had no path into any
+    honest mix. (Previously exclusion was observational and arrived one
+    round late, reference ``node/node.py:130-145`` semantics violated.)"""
+    victim = 3
+
+    def run(attack, byz):
+        cfg = CFG.replace(brb_enabled=True, aggregator="gossip")
+        exp = Experiment(cfg, attack=attack, byz_ids=byz)
+        exp.trust.hub.drop = lambda src, dst, data: src == victim
+        rec = exp.run_round(trainers=np.asarray(TRAINERS))
+        assert victim in rec.brb_excluded_trainers
+        return jax.tree.map(np.asarray, exp.state.params)
+
+    clean = run("none", ())
+    dirty = run("scale", (victim,))
+    honest = [i for i in range(CFG.num_peers) if i != victim]
+    saw_victim_diff = False
+    for a, b in zip(jax.tree.leaves(clean), jax.tree.leaves(dirty)):
+        np.testing.assert_array_equal(a[honest], b[honest])
+        saw_victim_diff |= bool(np.abs(a[victim] - b[victim]).max() > 0)
+    # Sanity: the corruption was real — the victim's own params differ.
+    assert saw_victim_diff
+
+
+def test_gossip_gated_all_verified_matches_ungated(mesh8):
+    """With every broadcast delivering, the verdict-masked mix must equal
+    the plain fused gossip round (the gate is pass-through)."""
+    cfg = CFG.replace(aggregator="gossip")
+    exp_gated, rec = _params_after_round(cfg.replace(brb_enabled=True), TRAINERS, mesh8)
+    assert rec.brb_excluded_trainers == []
+    exp_plain, _ = _params_after_round(cfg, TRAINERS, mesh8)
+    _assert_trees_close(exp_gated.state.params, exp_plain.state.params, atol=1e-6)
+
+
+def test_secure_dropout_rotates_dropped_peers_key(mesh8):
+    """Disclosure hygiene after recovery: a gated-out trainer's ECDH scalar
+    became reconstructible, so the driver rotates its key (runtime seed
+    matrix, no recompile) — the dropped peer's seed row changes, pairs not
+    involving it stay put, and the next round (with the peer re-joined)
+    still aggregates correctly under the fresh seeds."""
+    victim = 3
+    cfg = CFG.replace(brb_enabled=True, aggregator="secure_fedavg")
+    exp = Experiment(cfg)
+    before = exp._seed_mat.copy()
+    exp.trust.hub.drop = lambda src, dst, data: src == victim
+    rec = exp.run_round(trainers=np.asarray(TRAINERS))
+    assert rec.brb_excluded_trainers == [victim]
+    assert (exp._seed_mat[victim] != before[victim]).any()
+    others = [i for i in range(CFG.num_peers) if i != victim]
+    assert (exp._seed_mat[np.ix_(others, others)] == before[np.ix_(others, others)]).all()
+    # Re-joined victim masks under the fresh seeds; round completes clean.
+    exp.trust.hub.drop = None
+    rec2 = exp.run_round(trainers=np.asarray(TRAINERS))
+    assert rec2.brb_excluded_trainers == []
+    assert np.isfinite(rec2.train_loss) and np.isfinite(rec2.eval_acc)
