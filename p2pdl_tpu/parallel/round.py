@@ -301,6 +301,14 @@ def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
     )
 
 
+# Memo for builder-resolved ECDH seed matrices: the derivation is pure in
+# (num_peers, seed) but costs O(P^2/2) host-side ECDH (~1 min at P=1024);
+# without the cache every builder call (and every bench retry) would re-pay
+# it. Entries are treated as immutable — the driver's rotating matrix never
+# flows through here (it injects its own copy).
+_SEED_MATRIX_CACHE: dict[tuple[int, int], Any] = {}
+
+
 def _resolve_pair_seeds(cfg: Config, pair_seeds):
     """The key-derivation mode follows ``cfg.secure_agg_keys``, not whether
     the caller happened to plumb a matrix: with the default "ecdh" and no
@@ -314,9 +322,13 @@ def _resolve_pair_seeds(cfg: Config, pair_seeds):
         and cfg.aggregator == "secure_fedavg"
         and cfg.secure_agg_keys == "ecdh"
     ):
-        from p2pdl_tpu.protocol.secure_keys import SecureAggKeyring
+        key = (cfg.num_peers, cfg.seed)
+        pair_seeds = _SEED_MATRIX_CACHE.get(key)
+        if pair_seeds is None:
+            from p2pdl_tpu.protocol.secure_keys import SecureAggKeyring
 
-        pair_seeds = SecureAggKeyring(cfg.num_peers, seed=cfg.seed).seed_matrix()
+            pair_seeds = SecureAggKeyring(cfg.num_peers, seed=cfg.seed).seed_matrix()
+            _SEED_MATRIX_CACHE[key] = pair_seeds
     return pair_seeds
 
 
@@ -569,8 +581,11 @@ def build_trust_round_fns(
     l_per_dev = peers_per_device(cfg.num_peers, mesh)
     train = _local_train_phase(cfg, attack, model, opt, l_per_dev)
     # Runtime seeds: key rotation after dropout recovery swaps the matrix
-    # without recompiling the aggregate.
+    # without recompiling the aggregate. The resolved matrix doubles as the
+    # default `seeds` argument, so callers that never rotate (multihost
+    # workers, tests) need not thread it through.
     runtime_seeds = pair_seeds is not None
+    default_seeds = jnp.asarray(pair_seeds) if runtime_seeds else None
     agg = _aggregate_phase(cfg, l_per_dev, gated=True, runtime_seeds=runtime_seeds)
     sp = P(PEER_AXIS)
     sr = P()
@@ -608,8 +623,8 @@ def build_trust_round_fns(
         # phase was built with one.
         if masked_idx is None:
             masked_idx = trainer_idx
-        if runtime_seeds and seeds is None:
-            raise ValueError("this agg_fn was built with runtime seeds; pass seeds=")
+        if seeds is None:
+            seeds = default_seeds
         extra = (seeds,) if runtime_seeds else ()
         new_params, kept_opt = agg_smapped(
             state.params, state.opt_state, new_opt, delta, trainer_idx,

@@ -346,6 +346,11 @@ class MultiHostTrustPlane:
         self._reports: dict[int, dict] = {}
         self._decision: Optional[dict] = None
         self._acks: set[int] = set()
+        # Replay guard: signed frames are only accepted for the round the
+        # plane is currently running — a recorded, validly-signed frame
+        # from an earlier round must not clobber current state (stale
+        # report displacing a fresh one, stale decision blocking the slot).
+        self._active_round: Optional[int] = None
 
     # -- wire helpers ------------------------------------------------------
     @staticmethod
@@ -429,14 +434,22 @@ class MultiHostTrustPlane:
         elif kind == "report":
             # Unsigned/forged reports are dropped: a spoofed report could
             # fabricate delivery verdicts or digest attestations for peers
-            # it does not own.
-            if self._verify_frame(obj):
+            # it does not own. Stale rounds are dropped too (replay guard).
+            if (
+                obj.get("round") == self._active_round
+                and self._verify_frame(obj)
+            ):
                 self._reports[int(obj["host"])] = obj
         elif kind == "decision":
             # The decision gates the aggregate on every host — accept it
-            # only under the COORDINATOR's key (host 0). A frame that
-            # merely claims host 0 without its signature fails closed.
-            if int(obj.get("host", -1)) == 0 and self._verify_frame(obj):
+            # only under the COORDINATOR's key (host 0), and only for the
+            # active round (a replayed signed decision from an earlier
+            # round would otherwise occupy the slot and stall the round).
+            if (
+                obj.get("round") == self._active_round
+                and int(obj.get("host", -1)) == 0
+                and self._verify_frame(obj)
+            ):
                 self._decision = obj
 
     def _pump(self, deadline: float, done) -> bool:
@@ -517,6 +530,7 @@ class MultiHostTrustPlane:
         covers the trainers this host owns. ``equivocate`` is fault
         injection: those owned trainers send conflicting digests to the two
         halves of the host set."""
+        self._active_round = round_idx
         my_trainers = [t for t in trainer_ids if t in self.broadcasters]
         for tid in my_trainers:
             payload = self._payload(round_idx, tid, local_digests[tid])

@@ -22,11 +22,21 @@ def main() -> None:
     assert len(tp_ports) == nproc, (tp_ports, nproc)
     equivocate = "--equivocate" in sys.argv
     forge_decision = "--forge-decision" in sys.argv
+    secure = "--secure" in sys.argv
+
+    import os
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 2)
+    # Same persistent compile cache as tests/conftest.py — workers are fresh
+    # processes and would otherwise recompile the round every suite run.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     import jax.numpy as jnp
     import numpy as np
@@ -55,6 +65,10 @@ def main() -> None:
         # Also bounds the delivery pump when a broadcast can never deliver
         # (the equivocation variant) — keep it short for test wall-clock.
         round_timeout_s=8.0,
+        # --secure: ECDH-masked aggregation across hosts. Every host derives
+        # the identical seed matrix from cfg.seed independently, so the
+        # pairwise masks cancel inside the cross-process psum.
+        aggregator="secure_fedavg" if secure else "fedavg",
     )
     # Deterministic generation from the seed on every host; each host feeds
     # only its addressable shard (the host_local_batch contract).

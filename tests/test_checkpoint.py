@@ -226,6 +226,7 @@ def test_profiler_phase_stats():
     assert s["round"]["per_sec"] > 0
 
 
+@pytest.mark.slow
 def test_resume_matches_uninterrupted_model_parallel_momentum(tmp_path):
     """Resume determinism on a 2-D (peers x tp) mesh WITH momentum: the
     restored optimizer trace must land back on its per-leaf placement

@@ -100,6 +100,7 @@ def test_moe_vit_forward_has_expert_grads():
     assert "MoEFFN_0" not in params["TransformerBlock_0"]
 
 
+@pytest.mark.slow
 def test_ep_round_matches_dense(mesh8):
     """Framework level: cfg.ep_shards=2 runs the SAME federated round over a
     (peers x ep) mesh — expert leaves per-leaf sharded, tokens moved by

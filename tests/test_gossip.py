@@ -3,6 +3,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from p2pdl_tpu.ops.gossip import ring_mix
@@ -40,6 +41,7 @@ def test_ring_mix_matches_reference_ring(mesh8):
     np.testing.assert_allclose(out, w @ x, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_mix_converges_to_consensus(mesh8):
     x = jnp.asarray(np.random.default_rng(1).normal(size=(8, 4)).astype(np.float32))
     out = _mix_on_mesh(mesh8, x, rounds=60)
@@ -132,3 +134,76 @@ def test_exp_gossip_round_learns(mesh8):
         )
         losses.append(float(jnp.mean(m["train_loss"])))
     assert losses[-1] < losses[0]
+
+
+# ---- verdict-masked mixing (BRB in-round gating) ---------------------
+
+from p2pdl_tpu.ops.gossip import exp_mix  # noqa: E402
+
+
+def _masked_reference(x, mask, offsets, self_weight=1.0 / 3.0):
+    """Dense numpy oracle: w_ij = side * m_j for graph neighbors j, with the
+    excluded neighbors' mass reverting to self."""
+    n = x.shape[0]
+    side = (1.0 - self_weight) / 2.0
+    w = np.zeros((n, n), np.float32)
+    for i in range(n):
+        w[i, i] += self_weight
+        for off in offsets:
+            j = (i + off) % n
+            w[i, j] += side * mask[j]
+            w[i, i] += side * (1.0 - mask[j])
+    return w @ x
+
+
+def test_ring_mix_mask_matches_dense_oracle(mesh8):
+    n = 16
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    mask = np.ones(n, np.float32)
+    mask[[2, 9]] = 0.0  # two unverified peers
+    fn = jax.shard_map(
+        lambda xx, mm: ring_mix(xx, mask=mm),
+        mesh=mesh8, in_specs=(P(PEER_AXIS), P(PEER_AXIS)), out_specs=P(PEER_AXIS),
+    )
+    out = np.asarray(fn(jnp.asarray(x), jnp.asarray(mask)))
+    expect = _masked_reference(x, mask, (-1, +1))
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+    # Non-consumption: no honest row depends on an excluded peer's value.
+    x2 = x.copy()
+    x2[2] += 100.0
+    out2 = np.asarray(fn(jnp.asarray(x2), jnp.asarray(mask)))
+    honest = [i for i in range(n) if i != 2]
+    np.testing.assert_array_equal(out[honest], out2[honest])
+
+
+def test_exp_mix_mask_matches_dense_oracle(mesh8):
+    n = 16
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    mask = np.ones(n, np.float32)
+    mask[5] = 0.0
+    for r in (0, 1, 2):  # strides 1, 2, 4
+        fn = jax.shard_map(
+            lambda xx, mm, r=r: exp_mix(xx, jnp.int32(r), mask=mm),
+            mesh=mesh8, in_specs=(P(PEER_AXIS), P(PEER_AXIS)), out_specs=P(PEER_AXIS),
+        )
+        out = np.asarray(fn(jnp.asarray(x), jnp.asarray(mask)))
+        off = 2 ** r
+        expect = _masked_reference(x, mask, (-off, +off))
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_masked_mix_all_ones_equals_unmasked(mesh8):
+    x = jnp.asarray(np.random.default_rng(5).normal(size=(16, 4)).astype(np.float32))
+    ones = jnp.ones(16, jnp.float32)
+    fn_m = jax.shard_map(
+        lambda xx, mm: ring_mix(xx, mask=mm),
+        mesh=mesh8, in_specs=(P(PEER_AXIS), P(PEER_AXIS)), out_specs=P(PEER_AXIS),
+    )
+    fn = jax.shard_map(
+        ring_mix, mesh=mesh8, in_specs=P(PEER_AXIS), out_specs=P(PEER_AXIS)
+    )
+    np.testing.assert_allclose(
+        np.asarray(fn_m(x, ones)), np.asarray(fn(x)), rtol=1e-6, atol=1e-6
+    )

@@ -128,3 +128,70 @@ def test_forged_decision_rejected():
         assert r["verified"] == [2, 5, 7]
         assert 0 not in r["verified"]
     assert a["checksum"] == b["checksum"]
+
+
+def test_two_process_secure_aggregation():
+    """Secure aggregation composes with multi-host: each host derives the
+    SAME ECDH seed matrix from cfg.seed independently, every trainer masks
+    its delta, and the pairwise masks cancel inside the cross-process psum
+    — identical replicated params on both hosts, all trainers verified."""
+    a, b = _run_workers(("--secure",))
+    for r in (a, b):
+        assert r["verified"] == [0, 2, 5, 7]
+        assert r["local_loss_finite"]
+    assert a["checksum"] == b["checksum"]
+
+
+def test_replayed_signed_frames_rejected():
+    """Replay guard (unit, single host): a validly-SIGNED frame from an
+    earlier round must not be accepted while a later round is active —
+    signature freshness is per-round, or a recorded frame could displace a
+    current report / stall the decision slot."""
+    import json as _json
+
+    from p2pdl_tpu.config import Config
+    from p2pdl_tpu.runtime import multihost
+
+    import jax as _jax
+
+    from p2pdl_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8)
+    topo = multihost.HostTopology(
+        process_id=0, num_processes=1, local_devices=8, global_devices=8
+    )
+    cfg = Config(
+        num_peers=8, trainers_per_round=2, samples_per_peer=8, batch_size=8,
+        brb_enabled=True,
+    )
+    ports = _free_ports(1)
+    tp = multihost.MultiHostTrustPlane(
+        cfg, topo, mesh, [("127.0.0.1", ports[0])]
+    )
+    try:
+        stale = tp._sign_frame(
+            {"t": "report", "host": 0, "round": 0, "delivered": {}, "payloads": {},
+             "attest": {}}
+        )
+        fresh = tp._sign_frame(
+            {"t": "report", "host": 0, "round": 1, "delivered": {}, "payloads": {},
+             "attest": {}}
+        )
+        tp._active_round = 1
+        tp._handle(_json.dumps(stale).encode())
+        assert 0 not in tp._reports, "stale signed report must be dropped"
+        tp._handle(_json.dumps(fresh).encode())
+        assert 0 in tp._reports, "active-round signed report must be accepted"
+        # Decisions: stale signed decision dropped, active one accepted.
+        stale_d = tp._sign_frame(
+            {"t": "decision", "host": 0, "round": 0, "failed": [], "verified": []}
+        )
+        fresh_d = tp._sign_frame(
+            {"t": "decision", "host": 0, "round": 1, "failed": [], "verified": [0]}
+        )
+        tp._handle(_json.dumps(stale_d).encode())
+        assert tp._decision is None
+        tp._handle(_json.dumps(fresh_d).encode())
+        assert tp._decision is not None and tp._decision["round"] == 1
+    finally:
+        tp.stop()

@@ -64,6 +64,7 @@ def test_pp_forward_and_grads_match_dense():
         )
 
 
+@pytest.mark.slow
 def test_pp_round_matches_dense(mesh8):
     """Framework level: cfg.pp_shards=2 runs the SAME federated round over a
     (peers x pp) mesh — depth-stacked leaves per-leaf sharded, activations

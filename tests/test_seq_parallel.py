@@ -119,6 +119,7 @@ def test_mha_accepts_flash_with_seq_axis(mesh8):
     np.testing.assert_allclose(results["flash"], results["dense"], atol=2e-5)
 
 
+@pytest.mark.slow
 def test_vit_seq_parallel_round_matches_dense(mesh8):
     """The framework knob: cfg.seq_shards=2 runs the SAME federated round as
     seq_shards=1 — one compiled program over a (peers x seq) mesh with the

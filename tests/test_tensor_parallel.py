@@ -25,6 +25,7 @@ from p2pdl_tpu.parallel import (
 from p2pdl_tpu.parallel.mesh import data_sharding, make_mesh, peer_sharding
 
 
+@pytest.mark.slow
 def test_tp_forward_and_grads_match_dense():
     """Library level: the tp-sharded ViT (3-way head split) equals its dense
     twin on the SAME param tree — forward and all parameter gradients."""
@@ -52,6 +53,7 @@ def test_tp_forward_and_grads_match_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
 
 
+@pytest.mark.slow
 def test_tp_round_matches_dense(mesh8):
     """Framework level: cfg.tp_shards=2 runs the SAME federated round over a
     (peers x tp) mesh — params per-leaf sharded, two psums per block — with
