@@ -74,6 +74,15 @@ def build_parser() -> argparse.ArgumentParser:
         "experiment key (A/B benchmarking only)",
     )
     p.add_argument(
+        "--secure-agg-rekey",
+        choices=("never", "round"),
+        default="never",
+        help="key freshness: never = per-experiment keyring (gated-out peers "
+        "rotated after recovery); round = fresh ECDH keys + Shamir shares "
+        "every round (full Bonawitz per-execution semantics; BRB-gated "
+        "secure_fedavg, <= 256 peers)",
+    )
+    p.add_argument(
         "--peer-chunk",
         type=int,
         default=0,
@@ -242,6 +251,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         robust_impl=args.robust_impl,
         secure_agg_neighbors=args.secure_agg_neighbors,
         secure_agg_keys=args.secure_agg_keys,
+        secure_agg_rekey=args.secure_agg_rekey,
         peer_chunk=args.peer_chunk,
         brb_enabled=args.brb,
         round_timeout_s=args.round_timeout_s,

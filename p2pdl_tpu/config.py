@@ -100,6 +100,15 @@ class Config:
     # "shared" is the round-3 shared-experiment-key derivation, kept only
     # for A/B benchmarking the key plumbing's cost.
     secure_agg_keys: str = "ecdh"
+    # Key freshness: "never" = one keyring per experiment (a dropped peer's
+    # reconstructed scalar discloses its masks for rounds up to the drop;
+    # the driver rotates it afterwards). "round" = fresh ECDH keys + Shamir
+    # shares for EVERY peer EVERY round — the full Bonawitz per-execution
+    # semantics: reconstruction discloses exactly one round, ever. Costs
+    # O(P^2/2) host ECDH + O(P^2 t) share field ops per round, so it is
+    # validated to the BRB-gated path (runtime seed matrix; the fused paths
+    # bake seeds as compile-time constants) and to <= 256 peers.
+    secure_agg_rekey: str = "never"
     # Stream the vmapped peer stack through chunks of this size, fusing the
     # masked-sum aggregation into the scan: peak transient HBM becomes
     # O(peer_chunk x model) instead of O(peers_per_device x model) — how
@@ -397,6 +406,27 @@ class Config:
             raise ValueError(
                 f"unknown secure_agg_keys {self.secure_agg_keys!r}; one of ('ecdh', 'shared')"
             )
+        if self.secure_agg_rekey not in ("never", "round"):
+            raise ValueError(
+                f"unknown secure_agg_rekey {self.secure_agg_rekey!r}; one of ('never', 'round')"
+            )
+        if self.secure_agg_rekey == "round":
+            if self.secure_agg_keys != "ecdh" or self.aggregator != "secure_fedavg":
+                raise ValueError(
+                    "secure_agg_rekey='round' requires aggregator='secure_fedavg' "
+                    "with secure_agg_keys='ecdh'"
+                )
+            if not self.brb_enabled:
+                raise ValueError(
+                    "secure_agg_rekey='round' requires brb_enabled=True (only the "
+                    "gated pipeline takes the seed matrix at runtime; fused paths "
+                    "bake it as a compile-time constant)"
+                )
+            if self.num_peers > 256:
+                raise ValueError(
+                    "secure_agg_rekey='round' re-derives O(P^2) pair seeds per "
+                    f"round on the host; capped at 256 peers, got {self.num_peers}"
+                )
         if self.robust_impl not in ("blockwise", "gathered"):
             raise ValueError(
                 f"unknown robust_impl {self.robust_impl!r}; one of ('blockwise', 'gathered')"

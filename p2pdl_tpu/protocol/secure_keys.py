@@ -37,9 +37,12 @@ that logged its earlier masked updates can unmask them retroactively.
 What bounds the damage going FORWARD is :meth:`rotate`: the round driver
 re-keys every peer whose scalar became reconstructible (BRB gate-out
 under the gated pipeline), so a peer that later re-joins masks under a
-fresh scalar the old shares say nothing about. Per-round fresh DH for
-all pairs (full per-execution semantics) costs O(P x partners) ECDH per
-round and is the remaining delta, documented not implemented.
+fresh scalar the old shares say nothing about. For the full
+per-execution semantics — reconstruction can ever disclose exactly ONE
+round — set ``cfg.secure_agg_rekey="round"``: the driver re-keys every
+peer every round (fresh scalars + fresh shares; O(P^2/2) host ECDH per
+round, so config-capped at 256 peers and restricted to the BRB-gated
+path, whose seed matrix is a runtime argument).
 """
 
 from __future__ import annotations
@@ -129,14 +132,30 @@ class SecureAggKeyring:
                 mat[i, j] = mat[j, i] = (hi, lo)
         return mat
 
-    def rotate(self, peer_id: int, mat: np.ndarray | None = None, rng=None) -> None:
+    def rotate(
+        self,
+        peer_id: int,
+        mat: np.ndarray | None = None,
+        rng=None,
+        generation: int | None = None,
+    ) -> None:
         """Re-key ``peer_id`` after its scalar became reconstructible (it
         was gated out of a round where recovery could have run): fresh
         keypair, fresh Shamir shares (if distributed), and — when ``mat``
         is given — an in-place O(P) refresh of its seed-matrix row/column.
         Old shares say nothing about the new scalar, so a re-joining peer
-        masks with secrecy restored from this round forward."""
-        self._generation[peer_id] += 1
+        masks with secrecy restored from this round forward.
+
+        ``generation``: explicit key-schedule position. Per-round rekey
+        passes the absolute round index so a checkpoint-resumed experiment
+        re-derives the SAME per-round scalars as the uninterrupted run
+        (an in-memory counter would replay early generations after resume,
+        disclosing two rounds under one scalar). Default: bump by one
+        (the post-exclusion rotation path, where only freshness matters)."""
+        if generation is not None:
+            self._generation[peer_id] = generation
+        else:
+            self._generation[peer_id] += 1
         if self._seed is None:
             priv = ec.generate_private_key(ec.SECP256R1())
         else:

@@ -397,6 +397,22 @@ class Experiment:
         t0 = time.perf_counter()
         brb_delivered = brb_failed = brb_excluded = msgs = nbytes = None
         if self._gated:
+            if (
+                self.secure_keyring is not None
+                and self.cfg.secure_agg_rekey == "round"
+            ):
+                # Full Bonawitz per-execution freshness: every peer gets a
+                # new ECDH keypair + Shamir shares for THIS round, so a
+                # reconstructed scalar can ever disclose exactly one
+                # round's masks. Generation = absolute round index + 1, so
+                # a checkpoint resume re-derives the SAME key schedule as
+                # the uninterrupted run (bit-exact resume, and no scalar
+                # ever serves two rounds). Fresh matrix object per round —
+                # the previous round's device array is never touched.
+                for pid in range(self.cfg.num_peers):
+                    self.secure_keyring.rotate(pid, generation=r + 1)
+                self._seed_mat = self.secure_keyring.seed_matrix()
+                self._pair_seeds_dev = jnp.asarray(self._seed_mat)
             # BRB-gated pipeline: train -> digest+BRB -> gated aggregate.
             with self.profiler.phase("round"):
                 delta, new_opt, losses_dev = self.train_fn(
@@ -433,7 +449,15 @@ class Experiment:
                     mask_key, masked_idx=jnp.asarray(trainers, jnp.int32),
                     seeds=self._pair_seeds_dev,
                 )
-            if self.secure_keyring is not None and brb_excluded:
+            if (
+                self.secure_keyring is not None
+                and brb_excluded
+                and self.cfg.secure_agg_rekey != "round"
+            ):
+                # (Under rekey="round" this is dead weight: next round's
+                # full rekey supersedes any targeted rotation, and bumping
+                # counters here would make the key schedule depend on
+                # exclusion history.)
                 # Disclosure hygiene: a gated-out trainer's scalar became
                 # reconstructible (the recovery flow's premise), so rotate
                 # its key before it can mask again — old shares say nothing

@@ -190,6 +190,7 @@ RESUME_COMPATIBLE_FIELDS = (
     "seq_shards",
     "secure_agg_neighbors",
     "secure_agg_keys",
+    "secure_agg_rekey",
 )
 
 # Bumped when the PeerState pytree layout changes (v2: sync-layout params
