@@ -18,12 +18,22 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-ATTACKS = ("none", "sign_flip", "noise", "zero", "scale", "alie")
+ATTACKS = ("none", "sign_flip", "noise", "zero", "scale", "alie", "ipm")
 
 # ALIE perturbation magnitude in honest-update standard deviations. Baruch
 # et al. derive the largest z that keeps attackers inside the acceptance
 # envelope from (n, m); 1.0 is a conservative within-one-sigma choice.
 ALIE_Z = 1.0
+
+# IPM scaling: attackers submit -eps * mean(honest) (Xie et al. 2020,
+# "Fall of Empires"). The SUBMITTED vector is negatively aligned with the
+# honest direction; what it does to the aggregate depends on the defense:
+# against the mean family it shrinks the update toward zero (sign flips
+# only when eps > n_honest/n_byz — not at this eps with minority
+# attackers), while against selection-based defenses (Krum) the small
+# norm keeps it inside the distance-acceptance region, so a defense that
+# ever SELECTS it steps backwards. eps = 0.5 is the stealth regime.
+IPM_EPS = 0.5
 
 
 def apply_attack(
@@ -40,13 +50,18 @@ def apply_attack(
     ``deltas``: pytree with leading local-peer axis ``[L, ...]``;
     ``gate``: ``[L]`` 1.0 for Byzantine peers, 0.0 honest.
 
-    ``"alie"`` (A Little Is Enough, Baruch et al. 2019) is the ADAPTIVE
-    collusion: attackers submit ``mean - z * std`` of the HONEST updates
-    per coordinate — a coordinated pull that hides within the honest
-    spread, where naive magnitude-based defenses see nothing unusual.
-    It needs the honest population statistics, so ``axis_name`` must name
-    the peer mesh axis when called inside ``shard_map`` (local + psum
-    moments); the static corruptions ignore it.
+    Two ADAPTIVE collusions read the honest population's statistics (so
+    ``axis_name`` must name the peer mesh axis when called inside
+    ``shard_map``; the static corruptions ignore it):
+
+    - ``"alie"`` (A Little Is Enough, Baruch et al. 2019): attackers
+      submit ``mean - z * std`` of the honest updates per coordinate — a
+      pull hiding within the honest spread, invisible to magnitude-based
+      defenses.
+    - ``"ipm"`` (inner-product manipulation, Xie et al. 2020 "Fall of
+      Empires"): attackers submit ``-eps * mean`` of the honest updates —
+      small enough to sit inside every norm/distance acceptance region,
+      yet negatively aligned with the honest descent direction.
 
     ``peer_ids``: ``[L]`` GLOBAL peer ids of the stacked rows. The "noise"
     attack folds them into its draw keys, making the draws a function of
@@ -61,7 +76,7 @@ def apply_attack(
         raise ValueError(f"unknown attack {attack!r}; one of {ATTACKS}")
 
     leaves, treedef = jax.tree.flatten(deltas)
-    if attack == "alie":
+    if attack in ("alie", "ipm"):
         honest = (1.0 - gate).astype(jnp.float32)
 
         def total(x):
@@ -78,6 +93,14 @@ def apply_attack(
         )
         n_h = jnp.maximum(n_h, 1.0)
         means = [s / n_h.astype(s.dtype) for s in sums]
+        if attack == "ipm":
+            # Mean-only collusion: no second-moment psum round needed.
+            out = []
+            for l, mean in zip(leaves, means):
+                h = h_of(l)
+                bad = -jnp.asarray(IPM_EPS, l.dtype) * mean
+                out.append((1.0 - h) * bad + h * l)
+            return jax.tree.unflatten(treedef, out)
         sq = total(
             [
                 jnp.sum((l - m) ** 2 * h_of(l), axis=0)

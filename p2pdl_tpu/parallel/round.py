@@ -992,12 +992,13 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
     unchunked general body exactly for deterministic attacks and (by
     per-global-peer-id draw keys) the "noise" attack (test-asserted).
 
-    ALIE streams too: the envelope ``mean_h - z * std_h`` needs the honest
+    The adaptive collusions (ALIE, IPM) stream too: their envelopes
+    (``mean_h - z * std_h`` / ``-eps * mean_h``) need the honest
     population's moments, which no single chunk sees — but every attacker
     submits the SAME envelope value, and the mean family only consumes the
     trainer-gated SUM. So the scan accumulates honest raw moments
-    (``sum x``, ``sum x^2``, honest count) alongside the fold, zeroes
-    Byzantine trainers' contributions inside it, and adds
+    (``sum x``, plus ``sum x^2`` for ALIE, honest count) alongside the
+    fold, zeroes Byzantine trainers' contributions inside it, and adds
     ``n_byz_trainers x envelope`` once after the cross-device psum — one
     training pass, O(model) extra transient, exact up to the raw-vs-centered
     variance rounding (test-asserted vs the unchunked body).
@@ -1009,6 +1010,7 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
         raise ValueError(
             f"peer_chunk ({chunk}) must divide peers-per-device ({l_per_dev})"
         )
+    adaptive = attack in ("alie", "ipm")
     alie = attack == "alie"
     n_chunks = l_per_dev // chunk
 
@@ -1037,9 +1039,10 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
             )(pvaried, opt_c, keys_c, x_c, y_c)
             delta = jax.tree.map(lambda n, p: n - p[None], new_params, pvaried)
             is_trainer = jnp.isin(ids_c, trainer_idx)
-            if alie:
+            if adaptive:
                 # Stream the honest raw moments; zero Byzantine trainers'
-                # own contributions (their envelope lands post-psum).
+                # own contributions (their envelope lands post-psum). IPM
+                # needs the mean only — no second-moment tree.
                 s1, s2, n_h, n_bt = moments
                 honest = (1.0 - gate_c).astype(jnp.float32)
 
@@ -1049,9 +1052,10 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
                 s1 = jax.tree.map(
                     lambda a, l: a + jnp.sum(l * h_of(l), axis=0), s1, delta
                 )
-                s2 = jax.tree.map(
-                    lambda a, l: a + jnp.sum(l * l * h_of(l), axis=0), s2, delta
-                )
+                if alie:
+                    s2 = jax.tree.map(
+                        lambda a, l: a + jnp.sum(l * l * h_of(l), axis=0), s2, delta
+                    )
                 moments = (
                     s1, s2,
                     n_h + jnp.sum(honest),
@@ -1080,39 +1084,52 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
             return (jax.tree.map(fold, acc, delta), moments), losses
 
         acc0 = jax.tree.map(jnp.zeros_like, pvaried)
-        # Moment accumulators only exist under ALIE — otherwise the scan
-        # carry would haul two dead model-sized trees through every chunk.
+        # Moment accumulators only exist under the adaptive attacks —
+        # otherwise the scan carry would haul dead model-sized trees
+        # through every chunk (IPM carries the first moment only).
         # Scalar accumulators must start peer-VARYING (they sum the
         # peer-varying gate), or the scan carry types mismatch.
         zvar = lambda: jax.lax.pcast(jnp.float32(0.0), PEER_AXIS, to="varying")  # noqa: E731
         mom0 = (
             (
                 jax.tree.map(jnp.zeros_like, pvaried),
-                jax.tree.map(jnp.zeros_like, pvaried),
+                jax.tree.map(jnp.zeros_like, pvaried) if alie else (),
                 zvar(),
                 zvar(),
             )
-            if alie
+            if adaptive
             else ()
         )
         (acc, moments), losses = lax.scan(
             chunk_step, (acc0, mom0), chunked + (jnp.arange(n_chunks),)
         )
-        if alie:
-            from p2pdl_tpu.ops.attacks import ALIE_Z
+        if adaptive:
+            from p2pdl_tpu.ops.attacks import ALIE_Z, IPM_EPS
 
             s1, s2, n_h, n_bt = lax.psum(moments, PEER_AXIS)
             n_h = jnp.maximum(n_h, 1.0)
 
-            def envelope(a, m1, m2):
-                mean = m1 / n_h.astype(m1.dtype)
-                var = jnp.maximum(m2 / n_h.astype(m2.dtype) - mean * mean, 0.0)
-                bad = mean - jnp.asarray(ALIE_Z, mean.dtype) * jnp.sqrt(var)
-                return a + n_bt.astype(a.dtype) * bad
+            if alie:
+                def envelope(a, m1, m2):
+                    mean = m1 / n_h.astype(m1.dtype)
+                    var = jnp.maximum(m2 / n_h.astype(m2.dtype) - mean * mean, 0.0)
+                    bad = mean - jnp.asarray(ALIE_Z, mean.dtype) * jnp.sqrt(var)
+                    return a + n_bt.astype(a.dtype) * bad
 
-            acc = jax.tree.map(
-                envelope, jax.tree.map(lambda a: lax.psum(a, PEER_AXIS), acc), s1, s2
-            )
+                acc = jax.tree.map(
+                    envelope,
+                    jax.tree.map(lambda a: lax.psum(a, PEER_AXIS), acc), s1, s2,
+                )
+            else:
+                def envelope(a, m1):
+                    mean = m1 / n_h.astype(m1.dtype)
+                    bad = -jnp.asarray(IPM_EPS, mean.dtype) * mean
+                    return a + n_bt.astype(a.dtype) * bad
+
+                acc = jax.tree.map(
+                    envelope,
+                    jax.tree.map(lambda a: lax.psum(a, PEER_AXIS), acc), s1,
+                )
             agg = jax.tree.map(lambda a: a / count.astype(a.dtype), acc)
         else:
             agg = jax.tree.map(

@@ -102,3 +102,39 @@ def test_all_reducers_preserve_tree_structure():
         assert set(out.keys()) == {"w", "b"}
         assert out["w"].shape == (16, 16)
         assert out["b"].shape == (16,)
+
+
+def test_krum_defends_against_ipm():
+    """IPM (inner-product manipulation, Xie et al. 2020): colluders submit
+    -eps * mean(honest). Sharp discrimination, not a vacuous loss bound:
+
+    - the mean aggregate provably SHRINKS toward zero by (n_h - eps*m)/n —
+      the attack does real damage to the undefended path;
+    - Krum must select one of the HONEST updates bit-for-bit (the
+      corrupted rows sit on the wrong side of the honest cluster), so the
+      robust aggregate carries zero attacker influence."""
+    from p2pdl_tpu.ops.attacks import IPM_EPS, apply_attack
+
+    rng = np.random.default_rng(0)
+    n, d, m = 8, 64, 2
+    base = rng.normal(size=d).astype(np.float32)
+    honest = base + 0.05 * rng.normal(size=(n, d)).astype(np.float32)
+    gate = np.zeros(n, np.float32)
+    gate[[1, 6]] = 1.0
+    attacked = apply_attack("ipm", {"w": jnp.asarray(honest)}, jnp.asarray(gate),
+                            jax.random.PRNGKey(0))["w"]
+    attacked = np.asarray(attacked)
+    h_idx = [i for i in range(n) if gate[i] == 0.0]
+    mean_h = honest[h_idx].mean(0)
+    # Submitted attacker rows are -eps * mean(honest), negatively aligned.
+    np.testing.assert_allclose(attacked[1], -IPM_EPS * mean_h, rtol=1e-5)
+    assert float(attacked[1] @ mean_h) < 0
+    # Mean family: aggregate shrunk by exactly (n_h - eps*m)/n.
+    shrink = (len(h_idx) - IPM_EPS * m) / n
+    np.testing.assert_allclose(
+        attacked.mean(0), shrink * mean_h, rtol=1e-4, atol=1e-6
+    )
+    assert np.linalg.norm(attacked.mean(0) - mean_h) > 0.3 * np.linalg.norm(mean_h)
+    # Krum: the winner is bit-identical to one of the honest rows.
+    out = np.asarray(agg.krum({"w": jnp.asarray(attacked)}, f=m)["w"])
+    assert any(np.array_equal(out, honest[i]) for i in h_idx), "Krum picked a corrupted row"

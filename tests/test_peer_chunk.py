@@ -54,6 +54,8 @@ def _run_one_round(cfg, mesh, data, attack="none", byz=None):
         # once post-psum — equal to the unchunked body up to raw-vs-
         # centered variance rounding.
         ("fedavg", "alie"),
+        # ipm: mean-only adaptive collusion, same streaming machinery.
+        ("fedavg", "ipm"),
         ("secure_fedavg", "none"),
         ("secure_fedavg", "alie"),
     ],
@@ -81,7 +83,7 @@ def test_chunked_round_matches_general(mesh8, aggregator, attack):
         # alie's variance is raw-moment in the streamed body vs centered in
         # the unchunked one: identical in exact arithmetic, ~1e-5 apart in
         # float32 on lr-scaled deltas.
-        tol = 5e-5 if attack == "alie" else 1e-5
+        tol = 5e-5 if attack in ("alie", "ipm") else 1e-5
         for a, b in zip(jax.tree.leaves(got[0]), jax.tree.leaves(want[0])):
             np.testing.assert_allclose(a, b, atol=tol)
         np.testing.assert_allclose(got[1], want[1], atol=1e-6)
