@@ -21,6 +21,7 @@ AGGREGATORS = (
     "multi_krum",
     "trimmed_mean",
     "median",
+    "geometric_median",  # RFA (Pillutla et al.): smoothed Weiszfeld
     "gossip",  # selects the ring topology: decentralized D-PSGD neighbor mixing
     "secure_fedavg",
 )
@@ -481,15 +482,17 @@ class Config:
             )
         if self.aggregator == "gossip":
             raise ValueError(f"{knob} > 1 is not supported with gossip")
-        if self.aggregator in ("krum", "multi_krum"):
-            # Distance-based reducers score FULL updates; per-shard slices
-            # would score (and possibly select) different trainers per
-            # shard. Coordinate-wise reducers (trimmed_mean/median) act
-            # per-coordinate and stay correct per slice.
+        if self.aggregator in ("krum", "multi_krum", "geometric_median"):
+            # Distance-based reducers score/weight FULL updates; per-shard
+            # slices would score (krum) or Weiszfeld-weight
+            # (geometric_median) different trainers per shard, silently
+            # breaking the robustness guarantee. Coordinate-wise reducers
+            # (trimmed_mean/median) act per-coordinate and stay correct
+            # per slice.
             raise ValueError(
                 f"{knob} > 1 is not supported with distance-based robust "
-                f"reducers (krum/multi_krum); use trimmed_mean, median, or "
-                f"the fedavg family"
+                f"reducers (krum/multi_krum/geometric_median); use "
+                f"trimmed_mean, median, or the fedavg family"
             )
 
     @property

@@ -11,6 +11,7 @@ from p2pdl_tpu.ops.gossip import exp_mix, ring_mix
 from p2pdl_tpu.ops.pipeline import PipelinedBlocks
 from p2pdl_tpu.ops.aggregators import (
     fedavg,
+    geometric_median,
     krum,
     krum_scores,
     median,
@@ -20,6 +21,7 @@ from p2pdl_tpu.ops.aggregators import (
 )
 from p2pdl_tpu.ops.sharded_aggregators import (
     block_gram,
+    geometric_median_sharded,
     krum_sharded,
     median_sharded,
     multi_krum_sharded,
@@ -28,6 +30,8 @@ from p2pdl_tpu.ops.sharded_aggregators import (
 
 __all__ = [
     "fedavg",
+    "geometric_median",
+    "geometric_median_sharded",
     "krum",
     "krum_scores",
     "median",

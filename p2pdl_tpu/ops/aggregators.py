@@ -111,3 +111,52 @@ def trimmed_mean(deltas: Any, beta: float) -> Any:
 def median(deltas: Any) -> Any:
     """Coordinate-wise median over the update axis."""
     return jax.tree.map(lambda l: jnp.median(l, axis=0), deltas)
+
+
+# Weiszfeld iteration count for the geometric median. The smoothed
+# iteration contracts fast on clustered honest updates; 8 rounds lands
+# within float tolerance of the fixed point for the scales federated
+# deltas live at (test-asserted against direct minimization).
+GEOMEDIAN_ITERS = 8
+_GEOMEDIAN_SMOOTH = 1e-6
+
+
+def geometric_median(deltas: Any, iters: int = GEOMEDIAN_ITERS) -> Any:
+    """Geometric median of the stacked updates (RFA, Pillutla et al. 2022)
+    by smoothed Weiszfeld iteration — the rotation-invariant robust
+    aggregate: minimizes the sum of EUCLIDEAN distances over the whole
+    update vector, so unlike the coordinate-wise median/trimmed-mean its
+    breakdown behavior does not depend on the attack's coordinate basis.
+
+    ``z_{k+1} = sum_i w_i x_i / sum_i w_i`` with
+    ``w_i = 1 / max(||x_i - z_k||, smooth)``; distances accumulate across
+    leaves in float32 (full-vector distances, never a concatenated flat
+    matrix). Runs entirely on-device inside a ``lax.fori_loop``.
+    """
+    leaves = jax.tree.leaves(deltas)
+    t = leaves[0].shape[0]
+
+    def dists_to(z_leaves):
+        acc = jnp.zeros((t,), jnp.float32)
+        for l, z in zip(leaves, z_leaves):
+            d = (l.astype(jnp.float32) - z[None].astype(jnp.float32)).reshape(t, -1)
+            acc = acc + jnp.sum(d * d, axis=-1)
+        return jnp.sqrt(jnp.maximum(acc, 0.0))
+
+    def step(_, z_leaves):
+        w = 1.0 / jnp.maximum(dists_to(z_leaves), _GEOMEDIAN_SMOOTH)  # [T]
+        wsum = jnp.sum(w)
+        # Iterate stays float32 throughout: quantizing z to a low-precision
+        # leaf dtype each iteration would compound through the distance
+        # weights and diverge from the Gram-space sharded path (which
+        # carries float32 coefficients and applies them once).
+        return [
+            jnp.tensordot(w, l.astype(jnp.float32), axes=1) / wsum for l in leaves
+        ]
+
+    z0 = [jnp.mean(l.astype(jnp.float32), axis=0) for l in leaves]
+    z = jax.lax.fori_loop(0, iters, step, z0)
+    return jax.tree.unflatten(
+        jax.tree.structure(deltas),
+        [zz.astype(l.dtype) for zz, l in zip(z, leaves)],
+    )

@@ -233,3 +233,41 @@ def median_sharded(
         return 0.5 * (s[(t - 1) // 2] + s[t // 2])
 
     return _coordinate_reduce_sharded(delta, trainer_idx, reduce_fn, axis_name, block)
+
+
+def geometric_median_sharded(
+    delta: Any,
+    trainer_idx: jnp.ndarray,
+    iters: int | None = None,
+    axis_name: str = PEER_AXIS,
+    block: int | None = None,
+) -> Any:
+    """Geometric median (RFA / smoothed Weiszfeld) with O(P × block)
+    transient — the whole iteration runs in GRAM SPACE.
+
+    The Weiszfeld iterate is always a convex combination of the inputs,
+    ``z = sum_j c_j x_j``, so every distance it needs reduces to Gram
+    entries: ``||x_i - z||^2 = G_ii - 2 (G c)_i + c^T G c``. One blockwise
+    ``block_gram`` pass builds ``G`` (never materializing stacked full
+    vectors), the iteration updates only the ``[T]`` coefficient vector,
+    and the final median is extracted by a single weighted masked ``psum``.
+    Algebraically identical to ``aggregators.geometric_median`` on the
+    gathered stack (test-asserted to float tolerance)."""
+    from p2pdl_tpu.ops.aggregators import _GEOMEDIAN_SMOOTH, GEOMEDIAN_ITERS
+
+    if iters is None:
+        iters = GEOMEDIAN_ITERS
+    num_peers = jax.tree.leaves(delta)[0].shape[0] * lax.axis_size(axis_name)
+    gram = block_gram(delta, axis_name, block)  # [P, P] full-vector inner products
+    sub = gram[trainer_idx][:, trainer_idx].astype(jnp.float32)  # [T, T]
+    t = sub.shape[0]
+
+    def step(_, c):
+        gc = sub @ c
+        d2 = jnp.maximum(jnp.diagonal(sub) - 2.0 * gc + c @ gc, 0.0)
+        w = 1.0 / jnp.maximum(jnp.sqrt(d2), _GEOMEDIAN_SMOOTH)
+        return w / jnp.sum(w)
+
+    c = lax.fori_loop(0, iters, step, jnp.full((t,), 1.0 / t, jnp.float32))
+    weights = jnp.zeros((num_peers,), jnp.float32).at[trainer_idx].add(c)
+    return _extract_weighted(delta, weights, axis_name)

@@ -254,6 +254,8 @@ def _aggregate(cfg: Config, deltas_trainers: Any) -> Any:
         return aggregators.trimmed_mean(deltas_trainers, cfg.trimmed_mean_beta)
     if cfg.aggregator == "median":
         return aggregators.median(deltas_trainers)
+    if cfg.aggregator == "geometric_median":
+        return aggregators.geometric_median(deltas_trainers)
     raise ValueError(f"no gathered-reducer for {cfg.aggregator!r}")
 
 
@@ -272,6 +274,8 @@ def _aggregate_blockwise(cfg: Config, delta: Any, trainer_idx) -> Any:
         )
     if cfg.aggregator == "median":
         return sharded_aggregators.median_sharded(delta, trainer_idx)
+    if cfg.aggregator == "geometric_median":
+        return sharded_aggregators.geometric_median_sharded(delta, trainer_idx)
     raise ValueError(f"no blockwise reducer for {cfg.aggregator!r}")
 
 

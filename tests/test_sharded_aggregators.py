@@ -137,7 +137,52 @@ def test_krum_sharded_picks_central_under_outliers(mesh8):
         assert np.abs(np.asarray(leaf)).max() < 10.0
 
 
-@pytest.mark.parametrize("aggregator", ["krum", "multi_krum", "trimmed_mean", "median"])
+@pytest.mark.parametrize("block", [None, 64])
+def test_geometric_median_matches_dense(delta, mesh8, block):
+    """The Gram-space Weiszfeld (coefficients over [T, T] inner products)
+    must equal the coordinate-space iteration on the gathered stack."""
+    tidx = jnp.asarray(TRAINER_IDX, jnp.int32)
+    want = aggregators.geometric_median(jax.tree.map(lambda d: d[TRAINER_IDX], delta))
+    got = _run_sharded(
+        lambda d: sharded_aggregators.geometric_median_sharded(d, tidx, block=block),
+        delta,
+        mesh8,
+    )
+    _assert_trees_close(got, want, atol=5e-5)
+
+
+def test_geometric_median_robust_to_outliers():
+    """RFA sanity: with a minority of wild outliers the geometric median
+    stays near the honest cluster center, while the mean is dragged away."""
+    rng = np.random.default_rng(0)
+    honest = rng.normal(size=(6, 40)).astype(np.float32) * 0.1 + 1.0
+    outliers = np.full((2, 40), -50.0, np.float32)
+    stack = {"w": jnp.asarray(np.concatenate([honest, outliers]))}
+    gm = np.asarray(aggregators.geometric_median(stack)["w"])
+    mean = np.asarray(aggregators.fedavg(stack)["w"])
+    center = honest.mean(0)
+    assert np.linalg.norm(gm - center) < 0.5
+    assert np.linalg.norm(mean - center) > 10.0
+
+
+def test_geometric_median_is_weiszfeld_fixed_point():
+    """The iterate approximately satisfies the first-order condition of
+    min_z sum_i ||x_i - z||: the unit vectors from z to the points sum to
+    ~zero (smoothed Weiszfeld's stationarity)."""
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(9, 17)).astype(np.float32)
+    z = np.asarray(
+        aggregators.geometric_median({"w": jnp.asarray(x)}, iters=64)["w"]
+    )
+    diffs = x - z[None]
+    norms = np.linalg.norm(diffs, axis=1, keepdims=True)
+    residual = np.linalg.norm((diffs / norms).sum(0))
+    assert residual < 1e-2, residual
+
+
+@pytest.mark.parametrize(
+    "aggregator", ["krum", "multi_krum", "trimmed_mean", "median", "geometric_median"]
+)
 def test_round_blockwise_matches_gathered(aggregator, mesh8):
     """End-to-end: a full compiled round with robust_impl='blockwise' equals
     the same round with robust_impl='gathered'."""
