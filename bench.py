@@ -501,6 +501,20 @@ def matrix_entries() -> list[dict]:
                 robust_impl="blockwise",
             ),
         },
+        {
+            # Geometric median (RFA): the Gram-space Weiszfeld blockwise
+            # reducer under the IPM collusion — the rotation-invariant
+            # robust aggregate at the same 128-peer scale as the Krum row.
+            "name": "cifar10_cnn_128peers_geomedian_ipm",
+            "cfg": Config(
+                num_peers=128, trainers_per_round=32, local_epochs=1,
+                samples_per_peer=32, batch_size=32, model="simple_cnn",
+                dataset="cifar10", aggregator="geometric_median",
+                robust_impl="blockwise",
+            ),
+            "attack": "ipm",
+            "byz_ids": tuple(range(0, 128, 10)),
+        },
     ]
 
 

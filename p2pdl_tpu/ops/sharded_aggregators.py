@@ -76,12 +76,25 @@ def _chunked(flat: jnp.ndarray, block: int) -> jnp.ndarray:
 
 
 def block_gram(
-    delta: Any, axis_name: str = PEER_AXIS, block: int | None = None
+    delta: Any,
+    axis_name: str = PEER_AXIS,
+    block: int | None = None,
+    center_idx: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """``[P, P]`` Gram matrix of full flattened updates, streamed blockwise.
 
     Zero padding is Gram-neutral, so the result equals the dense
     ``flat @ flat.T`` over the concatenated update matrix.
+
+    ``center_idx``: subtract the MEAN over these rows from every gathered
+    chunk before accumulating. Distance computations built from Gram
+    entries (``|a-b|^2 = G_aa + G_bb - 2 G_ab``) are translation-invariant
+    in exact arithmetic but NOT in float32: federated deltas share a large
+    common component (the global gradient direction), so raw entries are
+    huge while the spreads distance math needs are tiny — catastrophic
+    cancellation that turns Krum scores and Weiszfeld weights into noise.
+    Centering on the trainer mean makes entries O(spread^2) and restores
+    conditioning; callers doing distance math should always pass it.
     """
     flat = _flatten_local(delta)
     num_peers = flat.shape[0] * lax.axis_size(axis_name)
@@ -90,6 +103,8 @@ def block_gram(
 
     def step(gram, chunk):
         g = lax.all_gather(chunk, axis_name, axis=0, tiled=True)  # [P, B]
+        if center_idx is not None:
+            g = g - jnp.mean(g[center_idx], axis=0, keepdims=True)
         return gram + g @ g.T, None
 
     gram0 = lax.pcast(
@@ -142,7 +157,8 @@ def krum_sharded(
 ) -> Any:
     """Krum's single most-central trainer update, O(P × block) transient."""
     num_peers = jax.tree.leaves(delta)[0].shape[0] * lax.axis_size(axis_name)
-    scores = _scores_from_gram(block_gram(delta, axis_name, block), trainer_idx, f)
+    gram = block_gram(delta, axis_name, block, center_idx=trainer_idx)
+    scores = _scores_from_gram(gram, trainer_idx, f)
     winner = trainer_idx[jnp.argmin(scores)]
     weights = (jnp.arange(num_peers) == winner).astype(jnp.float32)
     return _extract_weighted(delta, weights, axis_name)
@@ -163,7 +179,8 @@ def multi_krum_sharded(
     if m <= 0:
         m = max(t - f - 2, 1)
     m = min(m, t)
-    scores = _scores_from_gram(block_gram(delta, axis_name, block), trainer_idx, f)
+    gram = block_gram(delta, axis_name, block, center_idx=trainer_idx)
+    scores = _scores_from_gram(gram, trainer_idx, f)
     chosen = trainer_idx[jnp.argsort(scores)[:m]]
     weights = jnp.isin(jnp.arange(num_peers), chosen).astype(jnp.float32) / m
     return _extract_weighted(delta, weights, axis_name)
@@ -258,7 +275,13 @@ def geometric_median_sharded(
     if iters is None:
         iters = GEOMEDIAN_ITERS
     num_peers = jax.tree.leaves(delta)[0].shape[0] * lax.axis_size(axis_name)
-    gram = block_gram(delta, axis_name, block)  # [P, P] full-vector inner products
+    # Centered Gram: the geometric median is translation-equivariant and
+    # the coefficients sum to 1, so Weiszfeld over (x_i - mean) yields the
+    # SAME final point — while the centered entries are O(spread^2),
+    # avoiding the float32 cancellation that would otherwise flatten the
+    # weights toward uniform whenever updates share a large common
+    # component (the realistic correlated-deltas regime).
+    gram = block_gram(delta, axis_name, block, center_idx=trainer_idx)
     sub = gram[trainer_idx][:, trainer_idx].astype(jnp.float32)  # [T, T]
     t = sub.shape[0]
 
