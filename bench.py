@@ -22,7 +22,12 @@ Modes:
   (the driver contract) — stage progress goes to stderr.
 - ``--matrix``: the full BASELINE.md matrix (+ 1024-peer blockwise Krum and
   the fused-vs-dense attention microbench), one JSON line per entry,
-  written incrementally to ``BENCH_MATRIX.json``.
+  merged incrementally into ``BENCH_MATRIX.json``. Each entry runs in its
+  own watchdogged subprocess (``--matrix-entry NAME``, the child mode) so
+  one wedged remote compile cannot hang the whole capture; a captured
+  value is never clobbered by a later error. ``P2PDL_BENCH_ONLY=a,b``
+  filters jobs; ``P2PDL_BENCH_ENTRY_TIMEOUT`` / ``P2PDL_BENCH_HEAL_WAIT_S``
+  tune the watchdog and wedge-recovery budgets.
 - ``--time-to-acc [TARGET]``: CIFAR-10 time-to-accuracy (default 0.70),
   real dataset when present on disk, synthetic stand-in otherwise (the
   record carries ``dataset_source`` so nobody mistakes which one ran).
@@ -153,8 +158,10 @@ def _device_healthy() -> bool:
     """Backend reachable? The early __main__ gate already probed (and a
     wedged tunnel would have exited there); reuse its verdict rather than
     paying for a second probe subprocess. Callers that skipped the gate
-    (module import, P2PDL_BENCH_SKIP_PROBE) probe now."""
-    if os.environ.get(_PROBE_OK_ENV):
+    (module import) probe now; ``P2PDL_BENCH_SKIP_PROBE`` skips entirely
+    (CPU smoke runs on a loaded host, and --matrix-entry children whose
+    parent already probed)."""
+    if os.environ.get(_PROBE_OK_ENV) or os.environ.get("P2PDL_BENCH_SKIP_PROBE"):
         return True
     return probe_backend()
 
@@ -580,83 +587,281 @@ def bench_attention(
     return (timings[iters] - timings[1]) / (iters - 1) * 1000.0
 
 
-def run_matrix(timed_rounds: int = 10) -> list[dict]:
-    results: list[dict] = []
+# ---- Matrix orchestration: per-entry subprocess isolation. ----
+#
+# Learned on hardware (round 4): ONE pathological remote compile (the
+# ResNet-18 row) can wedge the whole compile-helper tunnel — in-process
+# sequencing then hangs the entire matrix run forever with zero rows
+# captured, and the wedge outlives the client. So every entry runs in its
+# OWN subprocess under a wall-clock watchdog; results merge into
+# BENCH_MATRIX.json one at a time (a captured value is never clobbered by
+# a later error); the job order puts never-captured rows first and the
+# observed wedge-trigger row LAST; and between entries the parent
+# re-probes the tunnel, waiting out a wedge up to a bounded heal budget
+# instead of burning watchdog timeouts against a dead backend.
 
-    def flush() -> None:
-        with open(MATRIX_PATH, "w") as f:
-            json.dump(results, f, indent=1)
+ENTRY_TIMEOUT_S = float(os.environ.get("P2PDL_BENCH_ENTRY_TIMEOUT", "1500"))
+HEAL_WAIT_S = float(os.environ.get("P2PDL_BENCH_HEAL_WAIT_S", "1800"))
 
-    for entry in matrix_entries():
-        name = f"agg_rounds_per_sec_{entry['name']}"
+_FUSED_ROUNDS = 16
+
+
+def matrix_jobs() -> list[str]:
+    """Single-entry job names in capture order. Plain names are matrix
+    configs; ``attn_T<len>`` is the fused-vs-dense microbench; ``fused:<name>``
+    is the multi-round-per-dispatch variant. Cheap + never-captured rows
+    lead; the ResNet row runs last (its compile is the one observed
+    wedging the remote compile-helper — if it wedges again, everything
+    else has already landed)."""
+    jobs = [
+        "mnist_mlp_8peers_fedavg",
+        "cifar10_vit_flash_8peers_fedavg",
+        "attn_T1024",
+        "attn_T4096",
+        "cifar10_moe_vit_8peers_fedavg",
+        "cifar10_cnn_128peers_geomedian_ipm",
+        "cifar10_cnn_128peers_krum_10pct_byz",
+        "cifar10_cnn_1024peers_krum_blockwise",
+        "shakespeare_lstm_256peers_gossip",
+        "vit_tiny_1024peers_secure_fedavg",
+        "fused:mnist_mlp_8peers_fedavg",
+        "fused:shakespeare_lstm_256peers_gossip",
+        "cifar10_resnet18_32peers_dirichlet",
+    ]
+    known = {e["name"] for e in matrix_entries()}
+    plain = {j for j in jobs if not j.startswith(("attn_T", "fused:"))}
+    missing = known - plain
+    if missing:  # a new matrix entry must never be silently unscheduled
+        raise AssertionError(f"matrix_jobs() missing entries: {sorted(missing)}")
+    referenced = plain | {j[len("fused:"):] for j in jobs if j.startswith("fused:")}
+    bogus = referenced - known  # ...and a typo'd job must fail here, not as
+    if bogus:  # an opaque child KeyError after a full subprocess spawn
+        raise AssertionError(f"matrix_jobs() references unknown entries: {sorted(bogus)}")
+    return jobs
+
+
+def _job_metric(job: str) -> str:
+    if job.startswith("attn_T"):
+        return f"attn_fwdbwd_ms_{job[len('attn_'):]}"
+    if job.startswith("fused:"):
+        return f"agg_rounds_per_sec_{job[len('fused:'):]}_fused{_FUSED_ROUNDS}"
+    return f"agg_rounds_per_sec_{job}"
+
+
+def run_single_entry(job: str, timed_rounds: int = 10) -> dict:
+    """One matrix job, in-process (the ``--matrix-entry`` child mode)."""
+    name = _job_metric(job)
+    if job.startswith("attn_T"):
+        seq_len = int(job[len("attn_T"):])
+        timing, err = _with_retry(
+            lambda: {
+                "dense_ms": round(bench_attention(seq_len, "dense"), 3),
+                "flash_ms": round(bench_attention(seq_len, "flash"), 3),
+            },
+            name,
+        )
+        if timing is None:
+            return err
+        return {
+            "metric": name,
+            **timing,
+            "speedup": round(timing["dense_ms"] / max(timing["flash_ms"], 1e-9), 3),
+            "unit": "ms",
+            "platform": jax.default_backend(),
+        }
+    entries = {e["name"]: e for e in matrix_entries()}
+    if job.startswith("fused:"):
+        entry = entries[job[len("fused:"):]]
         out, err = _with_retry(
-            lambda e=entry: bench_config(
-                e["cfg"],
-                attack=e.get("attack", "none"),
-                byz_ids=e.get("byz_ids", ()),
+            lambda: bench_config(
+                entry["cfg"], timed_rounds=64, fused_rounds=_FUSED_ROUNDS
+            ),
+            name,
+        )
+    else:
+        entry = entries[job]
+        out, err = _with_retry(
+            lambda: bench_config(
+                entry["cfg"],
+                attack=entry.get("attack", "none"),
+                byz_ids=entry.get("byz_ids", ()),
                 timed_rounds=timed_rounds,
             ),
             name,
         )
-        rec = (
-            {"metric": name, "value": round(out[0], 3), "unit": "rounds/sec", **out[1]}
-            if out is not None
-            else err
-        )
-        print(json.dumps(rec), flush=True)
-        results.append(rec)
-        flush()
+    if out is None:
+        return err
+    return {"metric": name, "value": round(out[0], 3), "unit": "rounds/sec", **out[1]}
 
-    # Fused multi-round mode (R rounds per dispatch): how much of the
-    # small-config round time was host dispatch.
-    # The two most dispatch-bound configs: the tiny MLP round and the
-    # 256-peer gossip ring (no role sampling between rounds to stop for).
-    entries = matrix_entries()
-    fused_names = ("mnist_mlp_8peers_fedavg", "shakespeare_lstm_256peers_gossip")
-    for entry in (e for e in entries if e["name"] in fused_names):
-        fused = 16
-        name = f"agg_rounds_per_sec_{entry['name']}_fused{fused}"
-        out, err = _with_retry(
-            lambda e=entry, f=fused: bench_config(
-                e["cfg"], timed_rounds=64, fused_rounds=f
-            ),
-            name,
-        )
-        rec = (
-            {"metric": name, "value": round(out[0], 3), "unit": "rounds/sec", **out[1]}
-            if out is not None
-            else err
-        )
-        print(json.dumps(rec), flush=True)
-        results.append(rec)
-        flush()
 
-    # Fused (Pallas) vs dense attention, fwd+bwd. Off-TPU the fused kernel
-    # auto-routes to dense, so the ratio is only meaningful on TPU — the
-    # record carries the platform.
-    platform = jax.default_backend()
-    for seq_len in (1024, 4096):
-        name = f"attn_fwdbwd_ms_T{seq_len}"
-        timing, err = _with_retry(
-            lambda t=seq_len: {
-                "dense_ms": round(bench_attention(t, "dense"), 3),
-                "flash_ms": round(bench_attention(t, "flash"), 3),
-            },
-            name,
-        )
-        if timing is not None:
+def _load_matrix() -> list[dict]:
+    """Missing file -> fresh list. A CORRUPT file is moved aside (never
+    silently treated as empty: the next save would then atomically replace
+    the artifact and destroy every previously captured value)."""
+    try:
+        with open(MATRIX_PATH) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return []
+    except Exception as e:
+        quarantine = f"{MATRIX_PATH}.corrupt-{os.getpid()}"
+        os.replace(MATRIX_PATH, quarantine)
+        _log(f"[bench] {MATRIX_PATH} unreadable ({e!r}); moved to {quarantine}")
+        return []
+
+
+def _is_capture(rec: dict) -> bool:
+    return "value" in rec or "dense_ms" in rec
+
+
+def _merge_record(results: list[dict], rec: dict) -> list[dict]:
+    """Replace-by-metric. A previously captured value is never clobbered
+    by a new error — the failed attempt is recorded on the kept row as
+    ``rerun_error`` instead."""
+    out, seen = [], False
+    for r in results:
+        if r.get("metric") != rec.get("metric"):
+            out.append(r)
+            continue
+        seen = True
+        if _is_capture(r) and not _is_capture(rec):
+            kept = dict(r)
+            kept["rerun_error"] = str(rec.get("error", "?"))[:300]
+            out.append(kept)
+        else:
+            out.append(rec)
+    if not seen:
+        out.append(rec)
+    return out
+
+
+def _probe_or_heal(metric: str) -> dict | None:
+    """Quick tunnel probe; on wedge, poll up to HEAL_WAIT_S for recovery.
+    Returns a skip record if the tunnel never heals, else None.
+    ``P2PDL_BENCH_SKIP_PROBE`` skips (CPU smoke runs: the probe subprocess
+    itself can exceed its timeout on a fully-loaded one-core host)."""
+    if os.environ.get("P2PDL_BENCH_SKIP_PROBE"):
+        return None
+    if probe_backend(attempts=1, timeout_s=90.0):
+        return None
+    t0 = time.time()
+    while time.time() - t0 < HEAL_WAIT_S:
+        _log(f"[bench] tunnel wedged before {metric}; heal-wait {int(time.time() - t0)}s")
+        time.sleep(120)
+        if probe_backend(attempts=1, timeout_s=90.0):
+            _log(f"[bench] tunnel healed after {int(time.time() - t0)}s")
+            return None
+    return {
+        "metric": metric,
+        "error": f"skipped: tunnel wedged past the {HEAL_WAIT_S:.0f}s heal-wait budget",
+        "skipped": True,
+    }
+
+
+def _save_matrix(results: list[dict]) -> None:
+    """Atomic rewrite (temp + rename): a mid-write kill must not truncate
+    the artifact and lose every previously captured value."""
+    tmp = MATRIX_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(results, f, indent=1)
+    os.replace(tmp, MATRIX_PATH)
+
+
+def _parse_last_json_dict(s: str | None) -> dict | None:
+    """Last stdout line that parses as a JSON *dict* (a bare number or
+    library banner is not a record)."""
+    for line in reversed((s or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict):
+            return parsed
+    return None
+
+
+def run_matrix() -> list[dict]:
+    import signal
+    import subprocess
+
+    canonical = {_job_metric(j) for j in matrix_jobs()}
+    # Prune rows no longer produced by any scheduled job (the early-gate
+    # "bench_matrix unreachable" record, renamed entries) so one failed
+    # run's marker can't read as a permanent failure next to fresh rows.
+    results = [r for r in _load_matrix() if r.get("metric") in canonical]
+    only = os.environ.get("P2PDL_BENCH_ONLY")
+    jobs = matrix_jobs()
+    if only:
+        wanted = [w.strip() for w in only.split(",") if w.strip()]
+        unknown = [w for w in wanted if w not in jobs]
+        if unknown:
+            raise SystemExit(f"P2PDL_BENCH_ONLY names unknown jobs: {unknown}; known: {jobs}")
+        jobs = [j for j in jobs if j in wanted]
+    env = dict(os.environ, P2PDL_BENCH_SKIP_PROBE="1")
+    env[_PROBE_OK_ENV] = "1"  # the parent probes between entries
+    tunnel_dead = False  # one exhausted heal-wait condemns the rest of the run
+    for job in jobs:
+        metric = _job_metric(job)
+        if tunnel_dead:
             rec = {
-                "metric": name,
-                **timing,
-                "speedup": round(timing["dense_ms"] / max(timing["flash_ms"], 1e-9), 3),
-                "unit": "ms",
-                "platform": platform,
+                "metric": metric,
+                "error": "skipped: tunnel already failed a full heal-wait this run",
+                "skipped": True,
             }
         else:
-            rec = err
+            rec = _probe_or_heal(metric)
+            if rec is not None:
+                tunnel_dead = True
+        if rec is None:
+            # Popen + process-group kill, not subprocess.run: the wedged
+            # compile-helper can outlive (and share pipes with) the child,
+            # in which case run()'s post-kill communicate() blocks forever
+            # on the inherited write-ends — the exact hang this watchdog
+            # exists to prevent.
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--matrix-entry", job],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                env=env,
+                start_new_session=True,
+            )
+            timed_out = False
+            try:
+                out_s, err_s = proc.communicate(timeout=ENTRY_TIMEOUT_S)
+            except subprocess.TimeoutExpired:
+                timed_out = True
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    proc.kill()
+                try:
+                    out_s, err_s = proc.communicate(timeout=30)
+                except subprocess.TimeoutExpired:  # pipes still held open
+                    out_s, err_s = "", ""
+            rec = _parse_last_json_dict(out_s)
+            if rec is not None and timed_out:
+                # The value was already printed; the child only wedged at
+                # teardown. Keep the capture, note the kill.
+                rec.setdefault(
+                    "note", f"child killed at {ENTRY_TIMEOUT_S:.0f}s after printing its record"
+                )
+            elif rec is None and timed_out:
+                rec = {
+                    "metric": metric,
+                    "error": f"entry timed out after {ENTRY_TIMEOUT_S:.0f}s "
+                    "(wedged remote compile?)",
+                    "timeout": True,
+                }
+            elif rec is None:
+                rec = {
+                    "metric": metric,
+                    "error": f"entry subprocess rc={proc.returncode}, no JSON; "
+                    f"stderr tail: {(err_s or '')[-300:]}",
+                }
+        results = _merge_record(results, rec)
         print(json.dumps(rec), flush=True)
-        results.append(rec)
-        flush()
+        _save_matrix(results)
     return results
 
 
@@ -819,6 +1024,10 @@ def main() -> None:
                 pass
         rec, err = _with_retry(lambda: run_time_to_acc(target), "time_to_acc")
         print(json.dumps(rec if rec is not None else err))
+        return
+    if "--matrix-entry" in sys.argv:
+        job = sys.argv[sys.argv.index("--matrix-entry") + 1]
+        print(json.dumps(run_single_entry(job)), flush=True)
         return
     if "--matrix" in sys.argv:
         run_matrix()
