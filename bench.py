@@ -700,7 +700,10 @@ def _load_matrix() -> list[dict]:
     the artifact and destroy every previously captured value)."""
     try:
         with open(MATRIX_PATH) as f:
-            return json.load(f)
+            loaded = json.load(f)
+        if not (isinstance(loaded, list) and all(isinstance(r, dict) for r in loaded)):
+            raise ValueError(f"expected a list of records, got {type(loaded).__name__}")
+        return loaded
     except FileNotFoundError:
         return []
     except Exception as e:
@@ -742,13 +745,15 @@ def _probe_or_heal(metric: str) -> dict | None:
     itself can exceed its timeout on a fully-loaded one-core host)."""
     if os.environ.get("P2PDL_BENCH_SKIP_PROBE"):
         return None
-    if probe_backend(attempts=1, timeout_s=90.0):
+    # Same 180s the early gate gives the identical probe: a slow-but-
+    # healthy tunnel false-failing here would condemn the whole run.
+    if probe_backend(attempts=1, timeout_s=180.0):
         return None
     t0 = time.time()
     while time.time() - t0 < HEAL_WAIT_S:
         _log(f"[bench] tunnel wedged before {metric}; heal-wait {int(time.time() - t0)}s")
         time.sleep(120)
-        if probe_backend(attempts=1, timeout_s=90.0):
+        if probe_backend(attempts=1, timeout_s=180.0):
             _log(f"[bench] tunnel healed after {int(time.time() - t0)}s")
             return None
     return {
@@ -767,15 +772,17 @@ def _save_matrix(results: list[dict]) -> None:
     os.replace(tmp, MATRIX_PATH)
 
 
-def _parse_last_json_dict(s: str | None) -> dict | None:
+def _parse_last_json_dict(s: str | None, metric: str | None = None) -> dict | None:
     """Last stdout line that parses as a JSON *dict* (a bare number or
-    library banner is not a record)."""
+    library banner is not a record). With ``metric``, only a dict carrying
+    that metric name counts — a stray JSON-object line from a library
+    printed after the real record must not displace it."""
     for line in reversed((s or "").strip().splitlines()):
         try:
             parsed = json.loads(line)
         except ValueError:
             continue
-        if isinstance(parsed, dict):
+        if isinstance(parsed, dict) and (metric is None or parsed.get("metric") == metric):
             return parsed
     return None
 
@@ -839,7 +846,7 @@ def run_matrix() -> list[dict]:
                     out_s, err_s = proc.communicate(timeout=30)
                 except subprocess.TimeoutExpired:  # pipes still held open
                     out_s, err_s = "", ""
-            rec = _parse_last_json_dict(out_s)
+            rec = _parse_last_json_dict(out_s, metric=metric)
             if rec is not None and timed_out:
                 # The value was already printed; the child only wedged at
                 # teardown. Keep the capture, note the kill.
