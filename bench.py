@@ -126,6 +126,16 @@ if __name__ == "__main__" and not os.environ.get("P2PDL_BENCH_SKIP_PROBE"):
         sys.exit(0)
 
 import jax
+
+# Persistent compilation cache, shared with the test suite. On the TPU
+# tunnel this is not just startup time: every compile avoided is one
+# fewer round-trip through the remote compile-helper — the single
+# flakiest component in this environment (observed wedging for hours) —
+# so matrix RE-runs skip straight to execution.
+from p2pdl_tpu.utils.jax_cache import configure_cache
+
+configure_cache()
+
 import jax.numpy as jnp
 import numpy as np
 

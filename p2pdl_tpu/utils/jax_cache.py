@@ -1,0 +1,31 @@
+"""Shared persistent-compilation-cache wiring.
+
+One ``.jax_cache`` directory at the repo root serves the test suite, the
+multihost worker processes, and the benchmark (entries are
+content-addressed per platform, so CPU and TPU executables coexist).
+Centralized here so the cache location and threshold cannot drift
+between call sites — a split cache silently forfeits both the warm-test
+speedup and, on the TPU tunnel, the far more important property that a
+re-run skips the remote compile-helper (the flakiest component in this
+environment) entirely.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def configure_cache(root: str | None = None, min_compile_secs: float = 0.5) -> str:
+    """Point JAX's persistent compilation cache at ``<root>/.jax_cache``.
+
+    Call after ``import jax`` and before the first compilation. Returns
+    the cache path.
+    """
+    path = os.path.join(root or _REPO_ROOT, ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", min_compile_secs)
+    return path

@@ -30,8 +30,9 @@ import pytest  # noqa: E402
 # compile times (24.5 min cold on this host); warm reruns skip recompiling
 # anything that took >0.5s. Safe across processes (content-addressed files),
 # so pytest-xdist workers share it.
-jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from p2pdl_tpu.utils.jax_cache import configure_cache  # noqa: E402
+
+configure_cache()
 
 # The image's sitecustomize may import jax with JAX_PLATFORMS pinned to a TPU
 # backend before this conftest runs; backends initialize lazily, so overriding

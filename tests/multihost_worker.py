@@ -32,11 +32,9 @@ def main() -> None:
     jax.config.update("jax_num_cpu_devices", 2)
     # Same persistent compile cache as tests/conftest.py — workers are fresh
     # processes and would otherwise recompile the round every suite run.
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    from p2pdl_tpu.utils.jax_cache import configure_cache
+
+    configure_cache()
 
     import jax.numpy as jnp
     import numpy as np
