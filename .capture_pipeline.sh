@@ -1,0 +1,24 @@
+#!/bin/bash
+# Wait for the TPU tunnel to heal (probe every 120s, up to 8h), then run
+# the full capture sequence. Each stage logs to .capture_pipeline.log.
+cd /root/repo
+log() { echo "$(date +%H:%M:%S) $*" >> .capture_pipeline.log; }
+log "pipeline start; waiting for tunnel"
+for i in $(seq 1 240); do
+  if timeout 60 python -c "import jax,jax.numpy as jnp; jnp.sum(jnp.ones((128,128))@jnp.ones((128,128))).block_until_ready(); print('ok')" 2>/dev/null | grep -q ok; then
+    log "tunnel healthy after $i probes"
+    break
+  fi
+  if [ "$i" = 240 ]; then log "tunnel never healed; giving up"; exit 1; fi
+  sleep 120
+done
+log "matrix start"
+P2PDL_BENCH_HEAL_WAIT_S=3600 python bench.py --matrix >> .capture_pipeline.log 2>.capture_matrix.err
+log "matrix done rc=$?"
+log "time-to-acc start"
+python bench.py --time-to-acc > TIME_TO_ACC.json 2>.capture_tta.err
+log "time-to-acc done rc=$?"
+log "tune-flash start"
+python bench.py --tune-flash >> .capture_pipeline.log 2>.capture_tune.err
+log "tune-flash done rc=$?"
+log "pipeline complete"

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Probe the TPU tunnel every 120s; log status; exit when healthy.
+while true; do
+  if timeout 60 python -c "import jax,jax.numpy as jnp; jnp.sum(jnp.ones((128,128))@jnp.ones((128,128))).block_until_ready(); print('ok')" 2>/dev/null | grep -q ok; then
+    echo "$(date +%H:%M:%S) HEALTHY" >> /root/repo/.tunnel_health.log
+    exit 0
+  else
+    echo "$(date +%H:%M:%S) wedged" >> /root/repo/.tunnel_health.log
+  fi
+  sleep 120
+done

@@ -22,6 +22,7 @@ AGGREGATORS = (
     "trimmed_mean",
     "median",
     "geometric_median",  # RFA (Pillutla et al.): smoothed Weiszfeld
+    "centered_clip",  # Karimireddy et al.: bounded-influence clipping iteration
     "gossip",  # selects the ring topology: decentralized D-PSGD neighbor mixing
     "secure_fedavg",
 )
@@ -84,6 +85,10 @@ class Config:
     gossip_graph: str = "ring"
     trimmed_mean_beta: float = 0.1  # fraction trimmed from each tail
     multi_krum_m: int = 0  # 0 => n_trainers - f - 2 selected
+    # Centered-clipping radius: 0 = scale-free auto (per-iteration median
+    # of ||x_i - v||); > 0 = fixed L2 radius in delta units.
+    cclip_tau: float = 0.0
+    cclip_iters: int = 0  # 0 => aggregators.CCLIP_ITERS (one shared default)
     # Robust-reducer execution strategy: "blockwise" streams the peer axis
     # through fixed-size feature blocks (O(peers x block) transient HBM —
     # scales to 1024 peers on real models); "gathered" all-gathers the full
@@ -434,6 +439,12 @@ class Config:
             )
         if not (0.0 <= self.trimmed_mean_beta < 0.5):
             raise ValueError(f"trimmed_mean_beta must be in [0, 0.5), got {self.trimmed_mean_beta}")
+        if self.cclip_tau < 0.0:
+            raise ValueError(f"cclip_tau must be >= 0 (0 = auto), got {self.cclip_tau}")
+        if self.cclip_iters < 0:
+            raise ValueError(
+                f"cclip_iters must be >= 0 (0 = library default), got {self.cclip_iters}"
+            )
         if self.samples_per_peer < self.batch_size:
             raise ValueError(
                 f"samples_per_peer ({self.samples_per_peer}) must be >= "
@@ -482,17 +493,18 @@ class Config:
             )
         if self.aggregator == "gossip":
             raise ValueError(f"{knob} > 1 is not supported with gossip")
-        if self.aggregator in ("krum", "multi_krum", "geometric_median"):
+        if self.aggregator in ("krum", "multi_krum", "geometric_median", "centered_clip"):
             # Distance-based reducers score/weight FULL updates; per-shard
-            # slices would score (krum) or Weiszfeld-weight
-            # (geometric_median) different trainers per shard, silently
-            # breaking the robustness guarantee. Coordinate-wise reducers
-            # (trimmed_mean/median) act per-coordinate and stay correct
-            # per slice.
+            # slices would score (krum), Weiszfeld-weight
+            # (geometric_median), or clip (centered_clip: the radius is an
+            # L2 bound on the WHOLE update) different trainers per shard,
+            # silently breaking the robustness guarantee. Coordinate-wise
+            # reducers (trimmed_mean/median) act per-coordinate and stay
+            # correct per slice.
             raise ValueError(
                 f"{knob} > 1 is not supported with distance-based robust "
-                f"reducers (krum/multi_krum/geometric_median); use "
-                f"trimmed_mean, median, or the fedavg family"
+                f"reducers (krum/multi_krum/geometric_median/centered_clip); "
+                f"use trimmed_mean, median, or the fedavg family"
             )
 
     @property

@@ -10,6 +10,7 @@ from p2pdl_tpu.ops.moe import MoEFFN, top1_route
 from p2pdl_tpu.ops.gossip import exp_mix, ring_mix
 from p2pdl_tpu.ops.pipeline import PipelinedBlocks
 from p2pdl_tpu.ops.aggregators import (
+    centered_clip,
     fedavg,
     geometric_median,
     krum,
@@ -21,6 +22,7 @@ from p2pdl_tpu.ops.aggregators import (
 )
 from p2pdl_tpu.ops.sharded_aggregators import (
     block_gram,
+    centered_clip_sharded,
     geometric_median_sharded,
     krum_sharded,
     median_sharded,
@@ -29,6 +31,8 @@ from p2pdl_tpu.ops.sharded_aggregators import (
 )
 
 __all__ = [
+    "centered_clip",
+    "centered_clip_sharded",
     "fedavg",
     "geometric_median",
     "geometric_median_sharded",

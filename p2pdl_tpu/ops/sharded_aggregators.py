@@ -252,6 +252,62 @@ def median_sharded(
     return _coordinate_reduce_sharded(delta, trainer_idx, reduce_fn, axis_name, block)
 
 
+def _dists_from_gram(sub: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
+    """``[T]`` distances ``||x_i - v||`` for ``v = sum_j c_j x_j`` (with
+    ``sum c = 1``) from the centered Gram matrix:
+    ``||x_i - v||^2 = G_ii - 2 (G c)_i + c^T G c``. Shared by every
+    Gram-space iterative reducer (geometric median, centered clipping) so
+    a conditioning or clamping change lands in all of them at once."""
+    gc = sub @ c
+    return jnp.sqrt(jnp.maximum(jnp.diagonal(sub) - 2.0 * gc + c @ gc, 0.0))
+
+
+def centered_clip_sharded(
+    delta: Any,
+    trainer_idx: jnp.ndarray,
+    tau: float = 0.0,
+    iters: int | None = None,
+    axis_name: str = PEER_AXIS,
+    block: int | None = None,
+) -> Any:
+    """Centered clipping with O(P × block) transient — the whole iteration
+    runs in GRAM SPACE, like :func:`geometric_median_sharded`.
+
+    The iterate ``v <- v + mean_i clip(x_i - v, tau)`` is an affine
+    combination of the inputs whose coefficients sum to 1:
+    ``c' = (1 - mean_i s_i) c + s / T`` with ``s_i = min(1, tau/||x_i - v||)``.
+    Distances come from the centered Gram matrix
+    (``||x_i - v||^2 = G_ii - 2 (G c)_i + c^T G c``; centering is exact
+    here because translation cancels inside ``x_i - v`` when the
+    coefficients sum to 1), the iteration updates only the ``[T]``
+    coefficient vector, and the result is extracted by one weighted masked
+    ``psum``. Matches ``aggregators.centered_clip`` on the gathered stack
+    (test-asserted to float tolerance)."""
+    from p2pdl_tpu.ops.aggregators import CCLIP_ITERS
+
+    if not iters:  # None or the 0 sentinel (Config.cclip_iters default)
+        iters = CCLIP_ITERS
+    num_peers = jax.tree.leaves(delta)[0].shape[0] * lax.axis_size(axis_name)
+    gram = block_gram(delta, axis_name, block, center_idx=trainer_idx)
+    sub = gram[trainer_idx][:, trainer_idx].astype(jnp.float32)  # [T, T]
+    t = sub.shape[0]
+    c0 = jnp.full((t,), 1.0 / t, jnp.float32)
+
+    def step(_, c):
+        d = _dists_from_gram(sub, c)
+        # Auto-tau re-estimated per iteration, exactly like the gathered
+        # path (see aggregators.centered_clip: a one-shot radius at the
+        # attack-dragged mean would be the attack scale, not the honest
+        # spread).
+        tau_eff = jnp.where(tau > 0, jnp.float32(tau), jnp.median(d))
+        s = jnp.minimum(1.0, tau_eff / jnp.maximum(d, 1e-12))
+        return (1.0 - jnp.mean(s)) * c + s / t
+
+    c = lax.fori_loop(0, iters, step, c0)
+    weights = jnp.zeros((num_peers,), jnp.float32).at[trainer_idx].add(c)
+    return _extract_weighted(delta, weights, axis_name)
+
+
 def geometric_median_sharded(
     delta: Any,
     trainer_idx: jnp.ndarray,
@@ -286,9 +342,7 @@ def geometric_median_sharded(
     t = sub.shape[0]
 
     def step(_, c):
-        gc = sub @ c
-        d2 = jnp.maximum(jnp.diagonal(sub) - 2.0 * gc + c @ gc, 0.0)
-        w = 1.0 / jnp.maximum(jnp.sqrt(d2), _GEOMEDIAN_SMOOTH)
+        w = 1.0 / jnp.maximum(_dists_from_gram(sub, c), _GEOMEDIAN_SMOOTH)
         return w / jnp.sum(w)
 
     c = lax.fori_loop(0, iters, step, jnp.full((t,), 1.0 / t, jnp.float32))

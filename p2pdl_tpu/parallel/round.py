@@ -256,6 +256,8 @@ def _aggregate(cfg: Config, deltas_trainers: Any) -> Any:
         return aggregators.median(deltas_trainers)
     if cfg.aggregator == "geometric_median":
         return aggregators.geometric_median(deltas_trainers)
+    if cfg.aggregator == "centered_clip":
+        return aggregators.centered_clip(deltas_trainers, cfg.cclip_tau, cfg.cclip_iters)
     raise ValueError(f"no gathered-reducer for {cfg.aggregator!r}")
 
 
@@ -276,6 +278,10 @@ def _aggregate_blockwise(cfg: Config, delta: Any, trainer_idx) -> Any:
         return sharded_aggregators.median_sharded(delta, trainer_idx)
     if cfg.aggregator == "geometric_median":
         return sharded_aggregators.geometric_median_sharded(delta, trainer_idx)
+    if cfg.aggregator == "centered_clip":
+        return sharded_aggregators.centered_clip_sharded(
+            delta, trainer_idx, cfg.cclip_tau, cfg.cclip_iters
+        )
     raise ValueError(f"no blockwise reducer for {cfg.aggregator!r}")
 
 

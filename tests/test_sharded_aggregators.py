@@ -215,8 +215,49 @@ def test_geometric_median_sharded_survives_correlated_deltas(delta, mesh8):
     _assert_trees_close(got_k, want_k, atol=1e-3)
 
 
+@pytest.mark.parametrize("block", [None, 64])
+@pytest.mark.parametrize("tau", [0.0, 0.5])
+def test_centered_clip_matches_dense(delta, mesh8, block, tau):
+    """The Gram-space clipping iteration (coefficients over [T, T] inner
+    products, per-iteration auto-tau from the same distances) must equal
+    the coordinate-space iteration on the gathered stack — for both the
+    scale-free auto radius and a fixed one."""
+    tidx = jnp.asarray(TRAINER_IDX, jnp.int32)
+    want = aggregators.centered_clip(
+        jax.tree.map(lambda d: d[TRAINER_IDX], delta), tau=tau
+    )
+    got = _run_sharded(
+        lambda d: sharded_aggregators.centered_clip_sharded(d, tidx, tau=tau, block=block),
+        delta,
+        mesh8,
+    )
+    _assert_trees_close(got, want, atol=5e-5)
+
+
+def test_centered_clip_sharded_survives_correlated_deltas(delta, mesh8):
+    """Same float32 killer as the Weiszfeld test: a 600x common offset must
+    not flatten the Gram-space clipping weights (centered Gram keeps the
+    per-iteration distances at spread scale)."""
+    offset = {k: 600.0 * jnp.ones_like(jax.tree.leaves({k: v})[0][0])
+              for k, v in delta.items()}
+    shifted = {k: v + offset[k][None] for k, v in delta.items()}
+    tidx = jnp.asarray(TRAINER_IDX, jnp.int32)
+    want = aggregators.centered_clip(
+        jax.tree.map(lambda d: d[TRAINER_IDX], shifted)
+    )
+    got = _run_sharded(
+        lambda d: sharded_aggregators.centered_clip_sharded(d, tidx),
+        shifted,
+        mesh8,
+    )
+    for k in shifted:
+        a = np.asarray(got[k]) - np.asarray(offset[k])
+        b = np.asarray(want[k]) - np.asarray(offset[k])
+        np.testing.assert_allclose(a, b, atol=1e-3)
+
+
 @pytest.mark.parametrize(
-    "aggregator", ["krum", "multi_krum", "trimmed_mean", "median", "geometric_median"]
+    "aggregator", ["krum", "multi_krum", "trimmed_mean", "median", "geometric_median", "centered_clip"]
 )
 def test_round_blockwise_matches_gathered(aggregator, mesh8):
     """End-to-end: a full compiled round with robust_impl='blockwise' equals
