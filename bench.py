@@ -519,6 +519,23 @@ def matrix_entries() -> list[dict]:
             ),
         },
         {
+            # Centered clipping under the ALIE collusion workload: the
+            # bounded-influence reducer (O(T x D), no pairwise distances)
+            # timed with the adaptive attack's honest-moment computation
+            # inside the round, same 128-peer scale as the Krum row. (Throughput row;
+            # the defense-discrimination tests live in
+            # tests/test_aggregators.py — vs IPM and wild outliers.)
+            "name": "cifar10_cnn_128peers_cclip_alie",
+            "cfg": Config(
+                num_peers=128, trainers_per_round=32, local_epochs=1,
+                samples_per_peer=32, batch_size=32, model="simple_cnn",
+                dataset="cifar10", aggregator="centered_clip",
+                robust_impl="blockwise",
+            ),
+            "attack": "alie",
+            "byz_ids": tuple(range(0, 128, 10)),
+        },
+        {
             # Geometric median (RFA): the Gram-space Weiszfeld blockwise
             # reducer under the IPM collusion — the rotation-invariant
             # robust aggregate at the same 128-peer scale as the Krum row.
@@ -629,6 +646,7 @@ def matrix_jobs() -> list[str]:
         "attn_T1024",
         "attn_T4096",
         "cifar10_moe_vit_8peers_fedavg",
+        "cifar10_cnn_128peers_cclip_alie",
         "cifar10_cnn_128peers_geomedian_ipm",
         "cifar10_cnn_128peers_krum_10pct_byz",
         "cifar10_cnn_1024peers_krum_blockwise",
