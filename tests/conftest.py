@@ -56,3 +56,27 @@ def mesh4():
 @pytest.fixture(scope="session")
 def mesh1():
     return make_mesh(1)
+
+
+def byz_stack(attack, n=8, d=64, byz=(1, 6), spread=0.05, seed=0):
+    """Shared Byzantine fixture: an honest cluster (base + spread*noise),
+    a gate over ``byz``, the attack applied — returns
+    ``(attacked_stack, honest_mean, honest_rows)``. One copy, used by the
+    spot tests (test_aggregators) and the full defense matrix, so a
+    change to ``apply_attack``'s convention lands everywhere at once."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2pdl_tpu.ops.attacks import apply_attack
+
+    rng = np.random.default_rng(seed)
+    base = rng.normal(size=d).astype(np.float32)
+    honest = base + spread * rng.normal(size=(n, d)).astype(np.float32)
+    gate = np.zeros(n, np.float32)
+    for i in byz:
+        gate[i] = 1.0
+    attacked = apply_attack(
+        attack, {"w": jnp.asarray(honest)}, jnp.asarray(gate), jax.random.PRNGKey(0)
+    )
+    h_idx = [i for i in range(n) if gate[i] == 0.0]
+    return attacked, honest[h_idx].mean(0), honest[h_idx]

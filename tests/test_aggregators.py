@@ -113,31 +113,25 @@ def test_krum_defends_against_ipm():
     - Krum must select one of the HONEST updates bit-for-bit (the
       corrupted rows sit on the wrong side of the honest cluster), so the
       robust aggregate carries zero attacker influence."""
-    from p2pdl_tpu.ops.attacks import IPM_EPS, apply_attack
+    from conftest import byz_stack
 
-    rng = np.random.default_rng(0)
-    n, d, m = 8, 64, 2
-    base = rng.normal(size=d).astype(np.float32)
-    honest = base + 0.05 * rng.normal(size=(n, d)).astype(np.float32)
-    gate = np.zeros(n, np.float32)
-    gate[[1, 6]] = 1.0
-    attacked = apply_attack("ipm", {"w": jnp.asarray(honest)}, jnp.asarray(gate),
-                            jax.random.PRNGKey(0))["w"]
-    attacked = np.asarray(attacked)
-    h_idx = [i for i in range(n) if gate[i] == 0.0]
-    mean_h = honest[h_idx].mean(0)
+    from p2pdl_tpu.ops.attacks import IPM_EPS
+
+    n, m = 8, 2
+    stack, mean_h, honest = byz_stack("ipm")
+    attacked = np.asarray(stack["w"])
     # Submitted attacker rows are -eps * mean(honest), negatively aligned.
     np.testing.assert_allclose(attacked[1], -IPM_EPS * mean_h, rtol=1e-5)
     assert float(attacked[1] @ mean_h) < 0
     # Mean family: aggregate shrunk by exactly (n_h - eps*m)/n.
-    shrink = (len(h_idx) - IPM_EPS * m) / n
+    shrink = (len(honest) - IPM_EPS * m) / n
     np.testing.assert_allclose(
         attacked.mean(0), shrink * mean_h, rtol=1e-4, atol=1e-6
     )
     assert np.linalg.norm(attacked.mean(0) - mean_h) > 0.3 * np.linalg.norm(mean_h)
     # Krum: the winner is bit-identical to one of the honest rows.
-    out = np.asarray(agg.krum({"w": jnp.asarray(attacked)}, f=m)["w"])
-    assert any(np.array_equal(out, honest[i]) for i in h_idx), "Krum picked a corrupted row"
+    out = np.asarray(agg.krum(stack, f=m)["w"])
+    assert any(np.array_equal(out, h) for h in honest), "Krum picked a corrupted row"
 
 
 def test_centered_clip_large_tau_equals_mean():
@@ -169,21 +163,11 @@ def test_centered_clip_defends_against_ipm():
     -eps * mean(honest). Centered clipping hard-bounds their per-update
     influence at tau/T, so the aggregate stays aligned with (and close to)
     the honest mean, recovering most of the shrink the plain mean suffers."""
-    from p2pdl_tpu.ops.attacks import apply_attack
+    from conftest import byz_stack
 
-    rng = np.random.default_rng(0)
-    n, d, m = 8, 64, 2
-    base = rng.normal(size=d).astype(np.float32)
-    honest = base + 0.05 * rng.normal(size=(n, d)).astype(np.float32)
-    gate = np.zeros(n, np.float32)
-    gate[[1, 6]] = 1.0
-    attacked = np.asarray(
-        apply_attack("ipm", {"w": jnp.asarray(honest)}, jnp.asarray(gate),
-                     jax.random.PRNGKey(0))["w"]
-    )
-    h_idx = [i for i in range(n) if gate[i] == 0.0]
-    mean_h = honest[h_idx].mean(0)
-    cc = np.asarray(agg.centered_clip({"w": jnp.asarray(attacked)})["w"])
+    stack, mean_h, _honest = byz_stack("ipm")
+    attacked = np.asarray(stack["w"])
+    cc = np.asarray(agg.centered_clip(stack)["w"])
     mean_err = np.linalg.norm(attacked.mean(0) - mean_h)
     cc_err = np.linalg.norm(cc - mean_h)
     # Strictly better than the undefended mean, and still pointing the
