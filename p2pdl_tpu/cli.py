@@ -43,6 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="L2 into the sgd update / decoupled AdamW for adam; 0=off",
     )
     p.add_argument("--server-lr", type=float, default=0.1)
+    p.add_argument(
+        "--server-momentum", type=float, default=0.0,
+        help="FedAvgM buffer decay (0 = reference semantics; pairs with "
+        "--aggregator centered_clip for the momentum+clip Byzantine defense)",
+    )
     p.add_argument("--model", choices=MODELS, default="mlp")
     p.add_argument("--dataset", choices=DATASETS, default="mnist")
     p.add_argument("--partition", choices=PARTITIONS, default="iid")
@@ -239,6 +244,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         optimizer=args.optimizer,
         weight_decay=args.weight_decay,
         server_lr=args.server_lr,
+        server_momentum=args.server_momentum,
         model=args.model,
         dataset=args.dataset,
         partition=args.partition,

@@ -66,6 +66,15 @@ class Config:
     # decoupled AdamW for adam. 0 = off.
     weight_decay: float = 0.0
     server_lr: float = 0.1
+    # Server momentum (FedAvgM, Hsu et al. 2019): the server keeps a
+    # momentum buffer over the aggregated delta — m <- beta*m + agg;
+    # params += server_lr * m. 0 = off (plain reference semantics).
+    # Beyond non-IID convergence, this is the temporal half of the
+    # Karimireddy et al. 2021 Byzantine defense: combined with
+    # aggregator="centered_clip", within-sigma collusions (ALIE) that a
+    # single-round reducer cannot discriminate get averaged down across
+    # rounds while their bounded per-round influence stays clipped.
+    server_momentum: float = 0.0
 
     # Model / data.
     model: str = "mlp"
@@ -212,6 +221,27 @@ class Config:
                 "momentum is an SGD knob; adam has its own betas "
                 "(set momentum=0.0 with optimizer='adam')"
             )
+        if not (0.0 <= self.server_momentum < 1.0):
+            raise ValueError(
+                f"server_momentum must be in [0, 1), got {self.server_momentum}"
+            )
+        if self.server_momentum > 0.0:
+            if self.server_lr <= 0.0:
+                raise ValueError(
+                    "server_momentum requires server_lr > 0 (the buffer "
+                    f"update divides by it), got server_lr={self.server_lr}"
+                )
+            if self.aggregator == "gossip":
+                raise ValueError(
+                    "server_momentum requires a server update; gossip is "
+                    "decentralized (no server) — use a sync-layout aggregator"
+                )
+            if self.brb_enabled:
+                raise ValueError(
+                    "server_momentum with the BRB trust plane is not yet "
+                    "supported (the gated two-program round applies its "
+                    "server update in the second program)"
+                )
         if self.weight_decay < 0:
             raise ValueError(f"weight_decay must be >= 0, got {self.weight_decay}")
         if self.gossip_graph not in ("ring", "exponential"):

@@ -57,6 +57,11 @@ class PeerState:
     opt_state: Any
     rng: jax.Array  # [P] peer PRNG keys (uint32 typed key array)
     round_idx: jax.Array  # scalar int32, replicated
+    # Server momentum buffer (FedAvgM): params-shaped float32 pytree when
+    # cfg.server_momentum > 0, None otherwise (None keeps the pytree
+    # structure — and every momentum-off code path — bit-identical to the
+    # pre-FedAvgM layout).
+    server_m: Any = None
 
 
 def params_layout(cfg: Config) -> str:
@@ -149,11 +154,17 @@ def init_peer_state(cfg: Config, key: jax.Array | None = None) -> PeerState:
 
     if params_layout(cfg) == "peer":
         params = jax.tree.map(stack, params)
+    server_m = None
+    if cfg.server_momentum > 0.0:
+        # Float32 regardless of param dtype: the buffer accumulates small
+        # aggregates across many rounds.
+        server_m = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
     return PeerState(
         params=params,
         opt_state=jax.tree.map(stack, opt_state),
         rng=jax.random.split(peer_key, cfg.num_peers),
         round_idx=jnp.zeros((), jnp.int32),
+        server_m=server_m,
     )
 
 
@@ -205,6 +216,9 @@ def shard_state(state: PeerState, cfg: Config, mesh) -> PeerState:
         opt_state=opt_shardings,
         rng=ps,
         round_idx=rs,
+        # The momentum buffer mirrors the params placement leaf-for-leaf
+        # (same shapes, same model-parallel splits).
+        server_m=None if state.server_m is None else param_shardings,
     )
     return jax.device_put(state, shardings)
 

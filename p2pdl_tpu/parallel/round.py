@@ -342,6 +342,35 @@ def _resolve_pair_seeds(cfg: Config, pair_seeds):
     return pair_seeds
 
 
+def _apply_server_momentum(cfg: Config, old_params, new_params, m):
+    """FedAvgM (Hsu et al. 2019) applied OUTSIDE the shard-mapped body.
+
+    Every sync body's server update is exactly ``p' = p + server_lr·agg``,
+    so the aggregate reconstructs as ``(p' - p)/server_lr`` from the
+    round-level replicated arrays — no body signature or spec changes for
+    any of the fast/general/chunked paths. Then ``m' = beta·m + agg`` and
+    ``p'' = p' + server_lr·beta·m  (= p + server_lr·m')``. All float32;
+    the reconstruction costs ~1 ulp of division rounding per round vs an
+    in-body implementation (the fused scan uses this same helper inside
+    its carry, and the fused==sequential test bounds the agreement).
+    """
+    s = jnp.float32(cfg.server_lr)
+    beta = jnp.float32(cfg.server_momentum)
+    new_m = jax.tree.map(
+        lambda mm, po, pn: beta * mm
+        + (pn.astype(jnp.float32) - po.astype(jnp.float32)) / s,
+        m,
+        old_params,
+        new_params,
+    )
+    out_p = jax.tree.map(
+        lambda pn, mm: (pn.astype(jnp.float32) + s * beta * mm).astype(pn.dtype),
+        new_params,
+        m,
+    )
+    return out_p, new_m
+
+
 def build_round_fn(
     cfg: Config, mesh: Mesh, attack: str = "none", pair_seeds=None
 ) -> Callable:
@@ -432,11 +461,17 @@ def build_round_fn(
         metrics = {"train_loss": losses}
         if emit_delta:
             metrics["delta"] = out[3]
+        server_m = state.server_m
+        if cfg.server_momentum > 0.0:
+            new_params, server_m = _apply_server_momentum(
+                cfg, state.params, new_params, server_m
+            )
         new_state = PeerState(
             params=new_params,
             opt_state=new_opt,
             rng=state.rng,
             round_idx=state.round_idx + 1,
+            server_m=server_m,
         )
         return new_state, metrics
 
@@ -499,9 +534,11 @@ def build_multi_round_fn(
     elif pp_axis is not None:
         params_spec, opt_spec = _model_parallel_specs(cfg, "pp")
 
-    def multi_body(params, opt_state, rng, x, y, trainer_mat, byz_gate, round0, base_key):
+    def multi_body(
+        params, opt_state, server_m, rng, x, y, trainer_mat, byz_gate, round0, base_key
+    ):
         def step(carry, inputs):
-            params, opt_state = carry
+            params, opt_state, server_m = carry
             trainer_idx, r = inputs
             # Absolute round index — identical mask/attack keys to the
             # sequential driver's fold_in(base, round_idx).
@@ -509,26 +546,37 @@ def build_multi_round_fn(
             new_p, new_opt, losses = body(
                 params, opt_state, rng, x, y, trainer_idx, byz_gate, round0 + r, mask_key
             )
-            return (new_p, new_opt), losses
+            if cfg.server_momentum > 0.0:
+                # Same helper as the sequential round — the momentum buffer
+                # rides the scan carry (replicated P() values inside
+                # shard_map, so the math is identical).
+                new_p, server_m = _apply_server_momentum(cfg, params, new_p, server_m)
+            return (new_p, new_opt, server_m), losses
 
         rounds = trainer_mat.shape[0]
-        (params, opt_state), losses = lax.scan(
-            step, (params, opt_state), (trainer_mat, jnp.arange(rounds))
+        (params, opt_state, server_m), losses = lax.scan(
+            step, (params, opt_state, server_m), (trainer_mat, jnp.arange(rounds))
         )
-        return params, opt_state, losses  # losses: [R, L]
+        return params, opt_state, server_m, losses  # losses: [R, L]
 
     x_spec = P(PEER_AXIS, None, SEQ_AXIS) if seq_axis is not None else sp
+    # Momentum off => server_m is None (zero pytree leaves): a per-leaf
+    # model-parallel spec TREE cannot prefix-broadcast over None, so the
+    # slot must degrade to a bare P() spec; momentum on mirrors the params
+    # placement leaf-for-leaf.
+    m_spec = params_spec if cfg.server_momentum > 0.0 else P()
     smapped = jax.shard_map(
         multi_body,
         mesh=mesh,
-        in_specs=(params_spec, opt_spec, sp, x_spec, sp, sr, sr, sr, sr),
-        out_specs=(params_spec, opt_spec, P(None, PEER_AXIS)),
+        in_specs=(params_spec, opt_spec, m_spec, sp, x_spec, sp, sr, sr, sr, sr),
+        out_specs=(params_spec, opt_spec, m_spec, P(None, PEER_AXIS)),
     )
 
     def multi_round_fn(state: PeerState, x, y, trainer_mat, byz_gate, base_key):
-        new_params, new_opt, losses = smapped(
+        new_params, new_opt, server_m, losses = smapped(
             state.params,
             state.opt_state,
+            state.server_m,
             state.rng,
             x,
             y,
@@ -542,6 +590,7 @@ def build_multi_round_fn(
             opt_state=new_opt,
             rng=state.rng,
             round_idx=state.round_idx + trainer_mat.shape[0],
+            server_m=server_m,
         )
         return new_state, {"train_loss": losses}
 

@@ -233,6 +233,10 @@ def shard_peer_state(state, cfg: Config, topo: HostTopology, mesh):
         ),
         rng=put_peer(state.rng),
         round_idx=put_rep(state.round_idx),
+        # Momentum buffer mirrors the (sync-layout) params placement.
+        server_m=None
+        if state.server_m is None
+        else jax.tree.map(put_rep, state.server_m),
     )
 
 

@@ -32,12 +32,17 @@ except Exception:  # pragma: no cover - orbax is in the base image
 
 
 def _state_to_tree(state: PeerState) -> dict[str, Any]:
-    return {
+    tree = {
         "params": state.params,
         "opt_state": state.opt_state,
         "rng": state.rng,
         "round_idx": state.round_idx,
     }
+    # Only materialized when FedAvgM is on — a momentum-off checkpoint keeps
+    # the pre-FedAvgM tree byte-for-byte (old checkpoints stay loadable).
+    if state.server_m is not None:
+        tree["server_m"] = state.server_m
+    return tree
 
 
 def _tree_to_state(tree: dict[str, Any]) -> PeerState:
@@ -46,6 +51,7 @@ def _tree_to_state(tree: dict[str, Any]) -> PeerState:
         opt_state=tree["opt_state"],
         rng=tree["rng"],
         round_idx=tree["round_idx"],
+        server_m=tree.get("server_m"),
     )
 
 
