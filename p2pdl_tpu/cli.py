@@ -45,8 +45,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--server-lr", type=float, default=0.1)
     p.add_argument(
         "--server-momentum", type=float, default=0.0,
-        help="FedAvgM buffer decay (0 = reference semantics; pairs with "
-        "--aggregator centered_clip for the momentum+clip Byzantine defense)",
+        help="FedAvgM server-momentum decay (0 = reference semantics; "
+        "non-IID convergence aid — for the Karimireddy momentum+clip "
+        "Byzantine defense use local --momentum with --aggregator "
+        "centered_clip)",
     )
     p.add_argument("--model", choices=MODELS, default="mlp")
     p.add_argument("--dataset", choices=DATASETS, default="mnist")

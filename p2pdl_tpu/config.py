@@ -69,11 +69,12 @@ class Config:
     # Server momentum (FedAvgM, Hsu et al. 2019): the server keeps a
     # momentum buffer over the aggregated delta — m <- beta*m + agg;
     # params += server_lr * m. 0 = off (plain reference semantics).
-    # Beyond non-IID convergence, this is the temporal half of the
-    # Karimireddy et al. 2021 Byzantine defense: combined with
-    # aggregator="centered_clip", within-sigma collusions (ALIE) that a
-    # single-round reducer cannot discriminate get averaged down across
-    # rounds while their bounded per-round influence stays clipped.
+    # This is the non-IID convergence tool. Note the distinction from the
+    # Karimireddy et al. 2021 Byzantine defense, which clips WORKER
+    # momenta: that maps to the local-optimizer `momentum` knob (per-peer
+    # temporal smoothing of the shipped deltas) combined with
+    # aggregator="centered_clip" — server-side momentum smooths the
+    # trajectory but cannot average away a persistent collusion bias.
     server_momentum: float = 0.0
 
     # Model / data.

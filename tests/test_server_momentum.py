@@ -3,9 +3,9 @@
 The server keeps a momentum buffer over the aggregated delta:
 ``m <- beta*m + agg; params += server_lr*m`` — reference semantics
 (plain ``+= server_lr*agg``, ``/root/reference/aggregator/aggregation.py:36-38``)
-at ``beta=0``. Beyond non-IID convergence this is the temporal half of
-the Karimireddy et al. 2021 Byzantine defense (momentum + centered
-clipping); the single-round half lives in ``ops.aggregators.centered_clip``.
+at ``beta=0``. This is the non-IID convergence tool (the Karimireddy
+et al. 2021 momentum+clip Byzantine defense clips WORKER momenta — the
+local ``momentum`` knob + ``centered_clip``, not this server buffer).
 """
 
 import jax
@@ -104,8 +104,9 @@ def test_fast_path_matches_general_with_momentum(mesh8):
 
 
 def test_momentum_composes_with_robust_aggregator(mesh8):
-    """FedAvgM over the centered-clip aggregate (the Karimireddy pipeline)
-    trains to accuracy under a sign-flip minority."""
+    """FedAvgM over the centered-clip aggregate trains to accuracy under
+    a sign-flip minority (composition sanity, not the worker-momentum
+    defense — that is local momentum + clip)."""
     cfg = Config(
         **{**CFG, "local_epochs": 2},
         server_momentum=0.9,
