@@ -23,6 +23,7 @@ AGGREGATORS = (
     "median",
     "geometric_median",  # RFA (Pillutla et al.): smoothed Weiszfeld
     "centered_clip",  # Karimireddy et al.: bounded-influence clipping iteration
+    "bulyan",  # El Mhamdi et al.: iterative-Krum select + per-coordinate trim
     "gossip",  # selects the ring topology: decentralized D-PSGD neighbor mixing
     "secure_fedavg",
 )
@@ -496,6 +497,13 @@ class Config:
                     f"{self.aggregator} needs trainers_per_round >= 2f+3 = "
                     f"{2 * self.byzantine_f + 3}, got {self.trainers_per_round}"
                 )
+        # Bulyan's two-stage guarantee needs T >= 4f + 3 (El Mhamdi et al. 2018).
+        if self.aggregator == "bulyan":
+            if self.trainers_per_round < 4 * self.byzantine_f + 3:
+                raise ValueError(
+                    f"bulyan needs trainers_per_round >= 4f+3 = "
+                    f"{4 * self.byzantine_f + 3}, got {self.trainers_per_round}"
+                )
 
     def _validate_model_parallel_knob(self, knob: str) -> None:
         """Shared restriction set for the tp/ep/pp second-mesh-axis knobs.
@@ -524,7 +532,9 @@ class Config:
             )
         if self.aggregator == "gossip":
             raise ValueError(f"{knob} > 1 is not supported with gossip")
-        if self.aggregator in ("krum", "multi_krum", "geometric_median", "centered_clip"):
+        if self.aggregator in (
+            "krum", "multi_krum", "geometric_median", "centered_clip", "bulyan",
+        ):
             # Distance-based reducers score/weight FULL updates; per-shard
             # slices would score (krum), Weiszfeld-weight
             # (geometric_median), or clip (centered_clip: the radius is an
@@ -534,8 +544,8 @@ class Config:
             # correct per slice.
             raise ValueError(
                 f"{knob} > 1 is not supported with distance-based robust "
-                f"reducers (krum/multi_krum/geometric_median/centered_clip); "
-                f"use trimmed_mean, median, or the fedavg family"
+                f"reducers (krum/multi_krum/geometric_median/centered_clip/"
+                f"bulyan); use trimmed_mean, median, or the fedavg family"
             )
 
     @property

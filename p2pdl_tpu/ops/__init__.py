@@ -10,6 +10,7 @@ from p2pdl_tpu.ops.moe import MoEFFN, top1_route
 from p2pdl_tpu.ops.gossip import exp_mix, ring_mix
 from p2pdl_tpu.ops.pipeline import PipelinedBlocks
 from p2pdl_tpu.ops.aggregators import (
+    bulyan,
     centered_clip,
     fedavg,
     geometric_median,
@@ -22,6 +23,7 @@ from p2pdl_tpu.ops.aggregators import (
 )
 from p2pdl_tpu.ops.sharded_aggregators import (
     block_gram,
+    bulyan_sharded,
     centered_clip_sharded,
     geometric_median_sharded,
     krum_sharded,
@@ -31,6 +33,8 @@ from p2pdl_tpu.ops.sharded_aggregators import (
 )
 
 __all__ = [
+    "bulyan",
+    "bulyan_sharded",
     "centered_clip",
     "centered_clip_sharded",
     "fedavg",

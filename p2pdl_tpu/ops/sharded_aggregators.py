@@ -117,16 +117,24 @@ def block_gram(
     return lax.psum(jnp.where(dev == 0, gram, jnp.zeros_like(gram)), axis_name)
 
 
+def _d2_from_gram(gram: jnp.ndarray, trainer_idx: jnp.ndarray) -> jnp.ndarray:
+    """``[T, T]`` pairwise squared distances over the trainer subset from
+    the (centered) Gram matrix — |a-b|^2 = |a|^2 + |b|^2 - 2<a,b>. ONE copy
+    of this conditioning-sensitive identity, shared by every Gram-space
+    consumer (Krum scores, Bulyan selection)."""
+    sub = gram[trainer_idx][:, trainer_idx].astype(jnp.float32)
+    sq = jnp.diagonal(sub)
+    return jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * sub, 0.0)
+
+
 def _scores_from_gram(gram: jnp.ndarray, trainer_idx: jnp.ndarray, f: int) -> jnp.ndarray:
     """Krum scores over the trainer subset: sum of each update's T-f-2
     smallest squared distances to the others (``aggregators.krum_scores``
     semantics, distances from the Gram identity |a-b|^2 = |a|^2+|b|^2-2ab)."""
-    sub = gram[trainer_idx][:, trainer_idx]  # [T, T]
-    t = sub.shape[0]
+    t = trainer_idx.shape[0]
     if t < 2 * f + 3:
         raise ValueError(f"krum requires T >= 2f+3 ({2 * f + 3}), got T={t}")
-    sq = jnp.diagonal(sub)
-    d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * sub, 0.0)
+    d2 = _d2_from_gram(gram, trainer_idx)
     d2 = d2 + jnp.diag(jnp.full((t,), jnp.inf, d2.dtype))
     return jnp.sum(jnp.sort(d2, axis=1)[:, : t - f - 2], axis=1)
 
@@ -248,6 +256,36 @@ def median_sharded(
     def reduce_fn(g):
         s = jnp.sort(g, axis=0)
         return 0.5 * (s[(t - 1) // 2] + s[t // 2])
+
+    return _coordinate_reduce_sharded(delta, trainer_idx, reduce_fn, axis_name, block)
+
+
+def bulyan_sharded(
+    delta: Any,
+    trainer_idx: jnp.ndarray,
+    f: int,
+    axis_name: str = PEER_AXIS,
+    block: int | None = None,
+) -> Any:
+    """Bulyan with O(P × block) transient: the iterative Krum selection
+    runs on the centered-Gram distance matrix (``[T, T]`` host of the same
+    ``_bulyan_select`` loop as the gathered path), and the per-coordinate
+    middle-slice aggregation streams through the feature blocks like
+    trimmed-mean — the selection mask rides into ``reduce_fn``."""
+    from p2pdl_tpu.ops.aggregators import _bulyan_select
+
+    t = trainer_idx.shape[0]
+    if t < 4 * f + 3:
+        raise ValueError(f"bulyan requires T >= 4f+3 ({4 * f + 3}), got T={t}")
+    theta = t - 2 * f
+    beta = theta - 2 * f
+    gram = block_gram(delta, axis_name, block, center_idx=trainer_idx)
+    sel = _bulyan_select(_d2_from_gram(gram, trainer_idx), f, theta)  # [T] 0/1
+
+    def reduce_fn(g):  # [T, B] this feature block's trainer values
+        masked = jnp.where(sel[:, None] > 0, g.astype(jnp.float32), jnp.inf)
+        srt = jnp.sort(masked, axis=0)[:theta]
+        return jnp.mean(srt[f : f + beta], axis=0)
 
     return _coordinate_reduce_sharded(delta, trainer_idx, reduce_fn, axis_name, block)
 

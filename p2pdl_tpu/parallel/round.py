@@ -258,6 +258,8 @@ def _aggregate(cfg: Config, deltas_trainers: Any) -> Any:
         return aggregators.geometric_median(deltas_trainers)
     if cfg.aggregator == "centered_clip":
         return aggregators.centered_clip(deltas_trainers, cfg.cclip_tau, cfg.cclip_iters)
+    if cfg.aggregator == "bulyan":
+        return aggregators.bulyan(deltas_trainers, cfg.byzantine_f)
     raise ValueError(f"no gathered-reducer for {cfg.aggregator!r}")
 
 
@@ -282,6 +284,8 @@ def _aggregate_blockwise(cfg: Config, delta: Any, trainer_idx) -> Any:
         return sharded_aggregators.centered_clip_sharded(
             delta, trainer_idx, cfg.cclip_tau, cfg.cclip_iters
         )
+    if cfg.aggregator == "bulyan":
+        return sharded_aggregators.bulyan_sharded(delta, trainer_idx, cfg.byzantine_f)
     raise ValueError(f"no blockwise reducer for {cfg.aggregator!r}")
 
 

@@ -175,3 +175,16 @@ def test_centered_clip_defends_against_ipm():
     assert cc_err < 0.5 * mean_err, (cc_err, mean_err)
     cos = float(cc @ mean_h / (np.linalg.norm(cc) * np.linalg.norm(mean_h)))
     assert cos > 0.95, cos
+
+
+def test_bulyan_can_select_peer_zero():
+    """Regression: the selection-loop carry must not poison index 0 (an
+    inf*0=NaN in the init once knocked peer 0 out of every selection).
+    Peer 0 is the exact centroid here — iterative Krum must pick it first."""
+    rng = np.random.default_rng(1)
+    pts = rng.normal(size=(8, 16)).astype(np.float32)
+    pts[0] = pts[1:].mean(0)  # most central by construction
+    d2 = np.asarray(agg.pairwise_sq_dists({"w": jnp.asarray(pts)}))
+    sel = np.asarray(agg._bulyan_select(jnp.asarray(d2), f=1, theta=6))
+    assert sel[0] == 1.0, sel
+    assert sel.sum() == 6.0

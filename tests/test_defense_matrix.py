@@ -63,10 +63,17 @@ def test_robust_beats_undefended(name, attack):
     assert err < 2 * honest_scale, (name, attack, err, honest_scale)
 
 
-@pytest.mark.parametrize("name", sorted(REDUCERS))
+# Bulyan joins the non-separating regimes on its own 12-peer stack
+# (T >= 4f+3); the dict value is (reducer, stack size).
+ALL_REDUCERS = {**{k: (v, 8) for k, v in REDUCERS.items()},
+                "bulyan": (lambda s: agg.bulyan(s, F), 12)}
+
+
+@pytest.mark.parametrize("name", sorted(ALL_REDUCERS))
 def test_alie_absolute_bound(name):
-    attacked, mean_h, honest = byz_stack("alie")
-    out = np.asarray(REDUCERS[name](attacked)["w"])
+    fn, n = ALL_REDUCERS[name]
+    attacked, mean_h, honest = byz_stack("alie", n=n)
+    out = np.asarray(fn(attacked)["w"])
     err = float(np.linalg.norm(out - mean_h))
     # ALIE sits within one sigma of the honest spread by construction, so
     # every reducer (and the mean) stays within a few honest radii — the
@@ -75,10 +82,27 @@ def test_alie_absolute_bound(name):
     assert err < 3 * honest_scale, (name, err, honest_scale)
 
 
-@pytest.mark.parametrize("name", sorted(REDUCERS))
+@pytest.mark.parametrize("attack", SEPARATING_ATTACKS)
+def test_bulyan_beats_undefended(attack):
+    """Bulyan needs T >= 4f+3 (El Mhamdi et al.), so its cells run on a
+    12-peer stack (f=2, same 2 colluders)."""
+    attacked, mean_h, honest = byz_stack(attack, n=12)
+    mean_err = float(np.linalg.norm(np.asarray(agg.fedavg(attacked)["w"]) - mean_h))
+    out = np.asarray(agg.bulyan(attacked, 2)["w"])
+    err = float(np.linalg.norm(out - mean_h))
+    honest_scale = float(np.linalg.norm(honest - mean_h, axis=1).max())
+    # Same decayed-attack guard as the 8-peer cells (2/12 Byzantine
+    # fraction separates less, so the guard matters MORE here).
+    assert mean_err > 2 * honest_scale, f"{attack} no longer displaces the mean"
+    assert err < 0.5 * mean_err, (attack, err, mean_err)
+    assert err < 2 * honest_scale, (attack, err, honest_scale)
+
+
+@pytest.mark.parametrize("name", sorted(ALL_REDUCERS))
 def test_clean_matches_mean_up_to_spread(name):
-    attacked, mean_h, honest = byz_stack("none")
-    out = np.asarray(REDUCERS[name](attacked)["w"])
+    fn, n = ALL_REDUCERS[name]
+    attacked, mean_h, honest = byz_stack("none", n=n)
+    out = np.asarray(fn(attacked)["w"])
     err = float(np.linalg.norm(out - mean_h))
     # No attack: every reducer sits inside the (full-population) cluster.
     scale = float(np.linalg.norm(np.asarray(attacked["w"]) - mean_h, axis=1).max())

@@ -216,6 +216,21 @@ def test_geometric_median_sharded_survives_correlated_deltas(delta, mesh8):
 
 
 @pytest.mark.parametrize("block", [None, 64])
+def test_bulyan_matches_dense(delta, mesh8, block):
+    """Gram-space iterative-Krum selection + streamed middle-slice
+    aggregation must equal the gathered Bulyan."""
+    f = 1  # T = 8 >= 4f+3 = 7
+    tidx = jnp.asarray(TRAINER_IDX, jnp.int32)
+    want = aggregators.bulyan(jax.tree.map(lambda d: d[TRAINER_IDX], delta), f)
+    got = _run_sharded(
+        lambda d: sharded_aggregators.bulyan_sharded(d, tidx, f, block=block),
+        delta,
+        mesh8,
+    )
+    _assert_trees_close(got, want, atol=5e-5)
+
+
+@pytest.mark.parametrize("block", [None, 64])
 @pytest.mark.parametrize("tau", [0.0, 0.5])
 def test_centered_clip_matches_dense(delta, mesh8, block, tau):
     """The Gram-space clipping iteration (coefficients over [T, T] inner
@@ -257,7 +272,7 @@ def test_centered_clip_sharded_survives_correlated_deltas(delta, mesh8):
 
 
 @pytest.mark.parametrize(
-    "aggregator", ["krum", "multi_krum", "trimmed_mean", "median", "geometric_median", "centered_clip"]
+    "aggregator", ["krum", "multi_krum", "trimmed_mean", "median", "geometric_median", "centered_clip", "bulyan"]
 )
 def test_round_blockwise_matches_gathered(aggregator, mesh8):
     """End-to-end: a full compiled round with robust_impl='blockwise' equals
