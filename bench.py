@@ -564,6 +564,21 @@ def matrix_entries() -> list[dict]:
             "byz_ids": tuple(range(0, 128, 10)),
         },
         {
+            # Bulyan: iterative-Krum selection on the centered Gram +
+            # streamed middle-slice aggregation, f=7 of 32 trainers
+            # (4f+3=31 <= 32) under sign-flip — the heaviest two-stage
+            # reducer at the 128-peer scale.
+            "name": "cifar10_cnn_128peers_bulyan_signflip",
+            "cfg": Config(
+                num_peers=128, trainers_per_round=32, local_epochs=1,
+                samples_per_peer=32, batch_size=32, model="simple_cnn",
+                dataset="cifar10", aggregator="bulyan", byzantine_f=7,
+                robust_impl="blockwise",
+            ),
+            "attack": "sign_flip",
+            "byz_ids": tuple(range(0, 128, 19)),
+        },
+        {
             # Geometric median (RFA): the Gram-space Weiszfeld blockwise
             # reducer under the IPM collusion — the rotation-invariant
             # robust aggregate at the same 128-peer scale as the Krum row.
@@ -675,6 +690,7 @@ def matrix_jobs() -> list[str]:
         "attn_T4096",
         "cifar10_moe_vit_8peers_fedavg",
         "cifar10_cnn_128peers_cclip_alie",
+        "cifar10_cnn_128peers_bulyan_signflip",
         "cifar10_cnn_128peers_geomedian_ipm",
         "cifar10_cnn_128peers_krum_10pct_byz",
         "cifar10_cnn_1024peers_krum_blockwise",
