@@ -44,6 +44,19 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--server-lr", type=float, default=0.1)
     p.add_argument(
+        "--dp-clip", type=float, default=0.0,
+        help="DP-FedAvg per-trainer L2 clip bound (0 = off)",
+    )
+    p.add_argument(
+        "--dp-noise-multiplier", type=float, default=0.0,
+        help="Gaussian noise multiplier z (std = z * clip / trainers on the "
+        "mean); per-round JSONL records carry the cumulative epsilon",
+    )
+    p.add_argument(
+        "--dp-delta", type=float, default=1e-5,
+        help="DP failure probability for the epsilon accounting",
+    )
+    p.add_argument(
         "--server-momentum", type=float, default=0.0,
         help="FedAvgM server-momentum decay (0 = reference semantics; "
         "non-IID convergence aid — for the Karimireddy momentum+clip "
@@ -247,6 +260,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
         weight_decay=args.weight_decay,
         server_lr=args.server_lr,
         server_momentum=args.server_momentum,
+        dp_clip=args.dp_clip,
+        dp_noise_multiplier=args.dp_noise_multiplier,
+        dp_delta=args.dp_delta,
         model=args.model,
         dataset=args.dataset,
         partition=args.partition,

@@ -100,6 +100,23 @@ class Config:
     # of ||x_i - v||); > 0 = fixed L2 radius in delta units.
     cclip_tau: float = 0.0
     cclip_iters: int = 0  # 0 => aggregators.CCLIP_ITERS (one shared default)
+    # Central differential privacy (DP-FedAvg, McMahan et al. 2018): every
+    # trainer's delta is L2-clipped to dp_clip BEFORE (secure-)masking and
+    # aggregation, and Gaussian noise with std = dp_noise_multiplier *
+    # dp_clip / live_trainers is added to the mean — so the server update
+    # is (eps, delta)-DP w.r.t. one trainer's contribution. 0 = off.
+    # utils/dp.rdp_epsilon converts (noise_multiplier, rounds, dp_delta)
+    # to a conservative epsilon (no subsampling amplification credit); the
+    # driver records the cumulative epsilon per round when enabled.
+    # THREAT MODEL (simulation semantics): the noise derives from the
+    # experiment PRNG stream (cfg.seed) for reproducibility, so epsilon
+    # holds against observers of the released models who do NOT hold the
+    # seed. A production deployment must draw the server noise from a
+    # secret CSPRNG — with the seed, the noise is replayable and epsilon
+    # is void. Same stance as standard FL simulators.
+    dp_clip: float = 0.0
+    dp_noise_multiplier: float = 0.0
+    dp_delta: float = 1e-5
     # Robust-reducer execution strategy: "blockwise" streams the peer axis
     # through fixed-size feature blocks (O(peers x block) transient HBM —
     # scales to 1024 peers on real models); "gathered" all-gathers the full
@@ -471,6 +488,44 @@ class Config:
             )
         if not (0.0 <= self.trimmed_mean_beta < 0.5):
             raise ValueError(f"trimmed_mean_beta must be in [0, 0.5), got {self.trimmed_mean_beta}")
+        if self.dp_clip < 0.0:
+            raise ValueError(f"dp_clip must be >= 0 (0 = off), got {self.dp_clip}")
+        if self.dp_noise_multiplier < 0.0:
+            raise ValueError(
+                f"dp_noise_multiplier must be >= 0, got {self.dp_noise_multiplier}"
+            )
+        if self.dp_noise_multiplier > 0.0 and self.dp_clip <= 0.0:
+            raise ValueError(
+                "dp_noise_multiplier needs dp_clip > 0: noise is calibrated "
+                "to the clip bound (std = z * clip / trainers); unclipped "
+                "updates have unbounded sensitivity and the noise would "
+                "certify nothing"
+            )
+        if self.dp_clip > 0.0:
+            if not (0.0 < self.dp_delta < 1.0):
+                raise ValueError(f"dp_delta must be in (0, 1), got {self.dp_delta}")
+            if self.aggregator not in ("fedavg", "secure_fedavg"):
+                raise ValueError(
+                    "dp_clip requires a mean-family aggregator (fedavg/"
+                    "secure_fedavg): the Gaussian-mechanism calibration is "
+                    "for the clipped MEAN; robust reducers need their own "
+                    "sensitivity analysis"
+                )
+            if self.peer_chunk > 0:
+                raise ValueError(
+                    "dp_clip with peer_chunk streaming is not yet supported "
+                    "(per-peer clipping would need to fuse into the chunk "
+                    "scan before the delta fold)"
+                )
+            if self.tp_shards > 1 or self.ep_shards > 1 or self.pp_shards > 1:
+                raise ValueError(
+                    "dp_clip with model-parallel sharding (tp/ep/pp) is not "
+                    "supported: each shard would clip its slice of a peer's "
+                    "delta independently (true sensitivity C*sqrt(shards), "
+                    "not the C the noise is calibrated for) and equal-shaped "
+                    "shards would draw correlated noise — the stated epsilon "
+                    "would overstate the guarantee"
+                )
         if self.cclip_tau < 0.0:
             raise ValueError(f"cclip_tau must be >= 0 (0 = auto), got {self.cclip_tau}")
         if self.cclip_iters < 0:

@@ -307,6 +307,7 @@ def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
         and cfg.ep_shards == 1
         and cfg.pp_shards == 1
         and cfg.optimizer == "sgd"
+        and cfg.dp_clip == 0.0  # per-peer clipping needs per-peer deltas
         and cfg.momentum == 0.0
         and cfg.weight_decay == 0.0
         and cfg.local_epochs == 1
@@ -936,6 +937,28 @@ def _aggregate_phase(cfg, l_per_dev, pair_seeds=None, gated=False, runtime_seeds
         local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
         is_trainer = jnp.isin(local_ids, trainer_idx)
 
+        if cfg.dp_clip > 0.0:
+            # DP-FedAvg clipping (McMahan et al. 2018): bound each peer's
+            # L2 contribution BEFORE masking and aggregation — on the raw
+            # delta, exactly what a DP client would ship (composes with
+            # secure aggregation: clip locally, then mask).
+            sq = sum(
+                jnp.sum(
+                    d.astype(jnp.float32).reshape(l_per_dev, -1) ** 2, axis=1
+                )
+                for d in jax.tree.leaves(delta)
+            )
+            clip_scale = jnp.minimum(
+                1.0, cfg.dp_clip / jnp.maximum(jnp.sqrt(sq), 1e-12)
+            )  # [L]
+            delta = jax.tree.map(
+                lambda d: (
+                    d.astype(jnp.float32)
+                    * clip_scale.reshape((l_per_dev,) + (1,) * (d.ndim - 1))
+                ).astype(d.dtype),
+                delta,
+            )
+
         if cfg.aggregator == "secure_fedavg":
             # Every PRE-gate trainer masked before the gate fell; gated-out
             # trainers' (masked) deltas are excluded wholesale by the
@@ -1000,6 +1023,25 @@ def _aggregate_phase(cfg, l_per_dev, pair_seeds=None, gated=False, runtime_seeds
             agg = jax.tree.map(
                 lambda a: lax.psum(jnp.where(dev == 0, a, jnp.zeros_like(a)), PEER_AXIS),
                 agg,
+            )
+
+        if cfg.dp_noise_multiplier > 0.0:
+            # Gaussian mechanism on the clipped mean: std = z * C / T_live
+            # (count is defined here — validation restricts DP to the mean
+            # family). The key derives from the replicated mask_key, so
+            # every device adds the IDENTICAL draw and peers stay in
+            # lockstep.
+            noise_key = jax.random.fold_in(mask_key, 0x6D70)  # "dp"
+            std = cfg.dp_noise_multiplier * cfg.dp_clip / count
+            leaves, treedef = jax.tree_util.tree_flatten(agg)
+            keys = jax.random.split(noise_key, len(leaves))
+            agg = jax.tree_util.tree_unflatten(
+                treedef,
+                [
+                    l
+                    + (std * jax.random.normal(k, l.shape, jnp.float32)).astype(l.dtype)
+                    for l, k in zip(leaves, keys)
+                ],
             )
 
         # Server update (reference applies 0.1 * avg_delta in place,

@@ -64,6 +64,9 @@ class RoundRecord:
     brb_excluded_trainers: Optional[list[int]] = None
     control_messages: Optional[int] = None
     control_bytes: Optional[int] = None
+    # Cumulative (eps, delta)-DP guarantee through THIS round (None unless
+    # dp_noise_multiplier > 0): utils/dp.rdp_epsilon over round+1 releases.
+    dp_epsilon: Optional[float] = None
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -364,6 +367,18 @@ class Experiment:
                 self._suspect_until[pid] = r + self.failure_cooldown_rounds
         return delivered, failed, excluded, verified, msgs, nbytes
 
+    def _dp_epsilon(self, rounds_done: int) -> Optional[float]:
+        """Cumulative (eps, cfg.dp_delta)-DP spent after ``rounds_done``
+        noisy releases; None when DP is off."""
+        if self.cfg.dp_noise_multiplier <= 0.0:
+            return None
+        from p2pdl_tpu.utils.dp import rdp_epsilon
+
+        eps, _ = rdp_epsilon(
+            self.cfg.dp_noise_multiplier, rounds_done, self.cfg.dp_delta
+        )
+        return round(eps, 4)
+
     def run_round(self, trainers: Optional[np.ndarray] = None) -> RoundRecord:
         """Run one round. ``trainers`` overrides role sampling (the Cluster
         facade passes the set its Nodes consented to, reference
@@ -533,6 +548,7 @@ class Experiment:
             brb_excluded_trainers=brb_excluded,
             control_messages=msgs,
             control_bytes=nbytes,
+            dp_epsilon=self._dp_epsilon(r + 1),
         )
         self.records.append(record)
         self.metrics.log(record.to_dict())
@@ -622,6 +638,7 @@ class Experiment:
                     eval_loss=float(ev["eval_loss"]) if last else None,
                     eval_acc=float(ev["eval_acc"]) if last else None,
                     duration_s=dt,
+                    dp_epsilon=self._dp_epsilon(r0 + i + 1),
                 )
                 self.records.append(record)
                 self.metrics.log(record.to_dict())
