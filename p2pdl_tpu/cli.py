@@ -44,6 +44,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--server-lr", type=float, default=0.1)
     p.add_argument(
+        "--fedprox-mu", type=float, default=0.0,
+        help="FedProx proximal coefficient (0 = plain FedAvg local objective)",
+    )
+    p.add_argument(
         "--dp-clip", type=float, default=0.0,
         help="DP-FedAvg per-trainer L2 clip bound (0 = off)",
     )
@@ -260,6 +264,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         weight_decay=args.weight_decay,
         server_lr=args.server_lr,
         server_momentum=args.server_momentum,
+        fedprox_mu=args.fedprox_mu,
         dp_clip=args.dp_clip,
         dp_noise_multiplier=args.dp_noise_multiplier,
         dp_delta=args.dp_delta,

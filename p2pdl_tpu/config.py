@@ -100,6 +100,12 @@ class Config:
     # of ||x_i - v||); > 0 = fixed L2 radius in delta units.
     cclip_tau: float = 0.0
     cclip_iters: int = 0  # 0 => aggregators.CCLIP_ITERS (one shared default)
+    # FedProx (Li et al., MLSys 2020): proximal term (mu/2)||w - w_round||^2
+    # on every local step's objective, anchored at the round's incoming
+    # global params — bounds client drift over multi-epoch local training
+    # on non-IID shards. 0 = off (plain FedAvg local objective). Purely a
+    # local-trainer change: composes with every aggregator, DP, momentum.
+    fedprox_mu: float = 0.0
     # Central differential privacy (DP-FedAvg, McMahan et al. 2018): every
     # trainer's delta is L2-clipped to dp_clip BEFORE (secure-)masking and
     # aggregation, and Gaussian noise with std = dp_noise_multiplier *
@@ -488,6 +494,8 @@ class Config:
             )
         if not (0.0 <= self.trimmed_mean_beta < 0.5):
             raise ValueError(f"trimmed_mean_beta must be in [0, 0.5), got {self.trimmed_mean_beta}")
+        if self.fedprox_mu < 0.0:
+            raise ValueError(f"fedprox_mu must be >= 0 (0 = off), got {self.fedprox_mu}")
         if self.dp_clip < 0.0:
             raise ValueError(f"dp_clip must be >= 0 (0 = off), got {self.dp_clip}")
         if self.dp_noise_multiplier < 0.0:

@@ -209,6 +209,7 @@ def make_local_train(
     if cfg.remat:
         loss_fn = jax.checkpoint(loss_fn)
     grad_fn = jax.value_and_grad(loss_fn)
+    mu = cfg.fedprox_mu
     s = cfg.samples_per_peer
     nb = cfg.batches_per_epoch
     b = cfg.batch_size
@@ -220,11 +221,39 @@ def make_local_train(
     shuffle = not (nb == 1 and nb * b == s and ep_axis is None)
 
     def local_train(params, opt_state, key, x, y):
+        # FedProx (Li et al., MLSys 2020): add (mu/2)||w - w_anchor||^2 to
+        # every local step's objective, anchored at THIS round's incoming
+        # params — bounds local drift over multi-step training on skewed
+        # shards. The prox gradient is zero at the anchor, so single-step
+        # rounds are bit-identical to FedAvg (test-asserted) and the
+        # pooled-gradient fast path stays exact. The REPORTED loss stays
+        # the data loss (the reference's progress metric), not data+prox.
+        if mu > 0.0:
+            anchor = params
+
+            def prox_grad(p, xb, yb):
+                def total(q):
+                    data = loss_fn(q, xb, yb)
+                    drift = sum(
+                        jnp.sum(
+                            (l.astype(jnp.float32) - a.astype(jnp.float32)) ** 2
+                        )
+                        for l, a in zip(jax.tree.leaves(q), jax.tree.leaves(anchor))
+                    )
+                    return data + 0.5 * mu * drift, data
+
+                (_, data), grads = jax.value_and_grad(total, has_aux=True)(p)
+                return data, grads
+
+            step_grad = prox_grad
+        else:
+            step_grad = grad_fn
+
         def epoch(carry, ekey):
             def batch_step(carry, batch):
                 params, opt_state = carry
                 xb, yb = batch
-                loss, grads = grad_fn(params, xb, yb)
+                loss, grads = step_grad(params, xb, yb)
                 updates, opt_state = opt.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 return (params, opt_state), loss
@@ -973,9 +1002,19 @@ def _aggregate_phase(cfg, l_per_dev, pair_seeds=None, gated=False, runtime_seeds
             )(delta, local_ids, is_masked)
 
         if cfg.aggregator in ("fedavg", "secure_fedavg"):
-            count = jnp.maximum(
-                lax.psum(jnp.sum(is_trainer.astype(jnp.float32)), PEER_AXIS), 1.0
-            )
+            if cfg.dp_clip > 0.0:
+                # FIXED denominator (McMahan et al. 2018's qW): dividing by
+                # the live count would make the denominator itself
+                # data-dependent and one trainer's influence up to 2C/T —
+                # silently doubling the privacy spend the accountant
+                # certifies. With sum/T_cfg the sensitivity is exactly
+                # C/T_cfg. (A vacancy-shrunken DP round underweights — the
+                # standard DP-FL tradeoff.)
+                count = jnp.float32(cfg.trainers_per_round)
+            else:
+                count = jnp.maximum(
+                    lax.psum(jnp.sum(is_trainer.astype(jnp.float32)), PEER_AXIS), 1.0
+                )
 
             # Masked-psum fast path: never materializes per-peer copies.
             def leaf(d):
@@ -1032,14 +1071,22 @@ def _aggregate_phase(cfg, l_per_dev, pair_seeds=None, gated=False, runtime_seeds
             # every device adds the IDENTICAL draw and peers stay in
             # lockstep.
             noise_key = jax.random.fold_in(mask_key, 0x6D70)  # "dp"
-            std = cfg.dp_noise_multiplier * cfg.dp_clip / count
+            # Static std (the fixed DP denominator, not the live count).
+            std = cfg.dp_noise_multiplier * cfg.dp_clip / cfg.trainers_per_round
             leaves, treedef = jax.tree_util.tree_flatten(agg)
             keys = jax.random.split(noise_key, len(leaves))
+            # Add in float32 and cast ONCE afterwards: casting the noise to
+            # a low-precision leaf dtype BEFORE the add would quantize it to
+            # the leaf's ulp grid (a discretized Gaussian breaks the
+            # continuous-mechanism RDP bound); quantizing the already-noised
+            # sum is data-independent post-processing, which preserves DP.
             agg = jax.tree_util.tree_unflatten(
                 treedef,
                 [
-                    l
-                    + (std * jax.random.normal(k, l.shape, jnp.float32)).astype(l.dtype)
+                    (
+                        l.astype(jnp.float32)
+                        + std * jax.random.normal(k, l.shape, jnp.float32)
+                    ).astype(l.dtype)
                     for l, k in zip(leaves, keys)
                 ],
             )

@@ -172,3 +172,35 @@ def test_config_rejects_model_parallel_dp():
             dataset="cifar10", vit_pool="mean", vit_heads=4, vit_depth=2,
             tp_shards=2, dp_clip=1.0,
         )
+
+
+def test_fixed_denominator_under_vacancy(mesh8):
+    """DP rounds divide by the CONFIGURED trainer count (McMahan's fixed
+    qW), not the live count — a data-dependent denominator would double
+    the sensitivity the noise is calibrated for. With half the slots
+    vacant, the DP aggregate is exactly half the live-mean aggregate."""
+    cfg = Config(**{**CFG, "trainers_per_round": 8}, dp_clip=1e6)
+    data = make_federated_data(cfg, eval_samples=16)
+    state = shard_state(init_peer_state(cfg), cfg, mesh8)
+    sh = peer_sharding(mesh8)
+    x = jax.device_put(data.x, sh)
+    y = jax.device_put(data.y, sh)
+    fn = build_round_fn(cfg, mesh8)
+    # 4 live trainers + 4 vacant (-1) slots.
+    tid = jnp.asarray([0, 1, 2, 3, -1, -1, -1, -1], jnp.int32)
+    before = init_peer_state(cfg).params
+    state, _ = fn(state, x, y, tid, jnp.zeros(8), jax.random.PRNGKey(0))
+    dp_agg = [
+        np.asarray(a, np.float64) - np.asarray(b, np.float64)
+        for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(before))
+    ]
+    plain = Config(**{**CFG, "trainers_per_round": 8})
+    pstate = shard_state(init_peer_state(plain), plain, mesh8)
+    pfn = build_round_fn(plain, mesh8)
+    pstate, _ = pfn(pstate, x, y, tid, jnp.zeros(8), jax.random.PRNGKey(0))
+    live_agg = [
+        np.asarray(a, np.float64) - np.asarray(b, np.float64)
+        for a, b in zip(jax.tree.leaves(pstate.params), jax.tree.leaves(before))
+    ]
+    for d, l in zip(dp_agg, live_agg):
+        np.testing.assert_allclose(d, l * 0.5, atol=1e-6)

@@ -1,0 +1,117 @@
+"""FedProx (Li et al., MLSys 2020): proximal local objective.
+
+Purely a local-trainer change (``parallel/round.make_local_train``); the
+reference's trainer has no drift control at all
+(``/root/reference/training/train.py:3-26``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.data import make_federated_data
+from p2pdl_tpu.parallel import (
+    build_round_fn,
+    init_peer_state,
+    peer_sharding,
+    shard_state,
+)
+
+CFG = dict(
+    num_peers=8,
+    trainers_per_round=8,
+    samples_per_peer=64,
+    batch_size=32,
+    lr=0.05,
+    server_lr=1.0,
+    model="mlp",
+    dataset="mnist",
+    partition="dirichlet",
+    dirichlet_alpha=0.1,
+    compute_dtype="float32",
+)
+
+
+def _run(cfg, mesh8, rounds=1):
+    data = make_federated_data(cfg, eval_samples=16)
+    state = shard_state(init_peer_state(cfg), cfg, mesh8)
+    sh = peer_sharding(mesh8)
+    x = jax.device_put(data.x, sh)
+    y = jax.device_put(data.y, sh)
+    fn = build_round_fn(cfg, mesh8)
+    tid = jnp.arange(8, dtype=jnp.int32)
+    for _ in range(rounds):
+        state, m = fn(state, x, y, tid, jnp.zeros(8), jax.random.PRNGKey(0))
+    return state, m
+
+
+def _dist(a, b):
+    return float(
+        sum(
+            jnp.sum((x.astype(jnp.float32) - y.astype(jnp.float32)) ** 2)
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+        )
+    ) ** 0.5
+
+
+def test_single_step_fedprox_equals_fedavg(mesh8):
+    """The prox gradient vanishes at the anchor, so one local step is
+    bit-identical to FedAvg — and the pooled-gradient fast path stays
+    exact with mu > 0."""
+    one_step = {**CFG, "local_epochs": 1, "samples_per_peer": 32}
+    plain, _ = _run(Config(**one_step), mesh8)
+    prox, _ = _run(Config(**one_step, fedprox_mu=1.0), mesh8)
+    for a, b in zip(jax.tree.leaves(plain.params), jax.tree.leaves(prox.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_mu_shrinks_drift_monotonically(mesh8):
+    """Multi-epoch local training on skewed shards: larger mu pulls the
+    round's aggregate strictly closer to the incoming global params."""
+    anchor = init_peer_state(Config(**CFG, local_epochs=5)).params
+    drifts = []
+    for mu in (0.0, 0.1, 1.0, 10.0):
+        cfg = Config(**CFG, local_epochs=5, fedprox_mu=mu)
+        state, _ = _run(cfg, mesh8)
+        drifts.append(_dist(state.params, anchor))
+    assert drifts[0] > drifts[1] > drifts[2] > drifts[3], drifts
+    assert drifts[3] < 0.5 * drifts[0], drifts  # mu=10 really binds
+
+
+def test_reported_loss_is_data_loss_not_prox(mesh8):
+    """The JSONL progress metric must stay comparable across mu settings —
+    the data loss, not data + prox penalty. (Measured: mu=10 reports ~1.0
+    vs ~0.7 at mu=0; a prox-inflated total would add 0.5*mu*drift^2 and
+    blow past that band. mu stays in the lr*mu < 2 stability region —
+    larger products make the prox gradient itself overshoot.)"""
+    _, m0 = _run(Config(**CFG, local_epochs=3), mesh8)
+    _, m10 = _run(Config(**CFG, local_epochs=3, fedprox_mu=10.0), mesh8)
+    l0 = float(jnp.mean(m0["train_loss"]))
+    l10 = float(jnp.mean(m10["train_loss"]))
+    assert l10 < 2.0 * l0 + 0.5, (l10, l0)
+
+
+def test_fedprox_learns(mesh8):
+    from p2pdl_tpu.parallel import build_eval_fn
+
+    cfg = Config(**CFG, local_epochs=3, fedprox_mu=0.1)
+    data = make_federated_data(cfg, eval_samples=256)
+    state = shard_state(init_peer_state(cfg), cfg, mesh8)
+    sh = peer_sharding(mesh8)
+    x = jax.device_put(data.x, sh)
+    y = jax.device_put(data.y, sh)
+    fn = build_round_fn(cfg, mesh8)
+    tid = jnp.arange(8, dtype=jnp.int32)
+    for _ in range(10):
+        state, _ = fn(state, x, y, tid, jnp.zeros(8), jax.random.PRNGKey(0))
+    acc = float(
+        jnp.mean(build_eval_fn(cfg)(state, data.eval_x, data.eval_y)["eval_acc"])
+    )
+    assert acc > 0.9, acc  # measured 0.965 at round 10 on this seed
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="fedprox_mu"):
+        Config(**CFG, fedprox_mu=-0.5)
