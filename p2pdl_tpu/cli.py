@@ -48,6 +48,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="FedProx proximal coefficient (0 = plain FedAvg local objective)",
     )
     p.add_argument(
+        "--scaffold", action="store_true",
+        help="SCAFFOLD control variates (per-peer c_i + server c correct "
+        "client drift at every local step; plain-SGD fedavg only)",
+    )
+    p.add_argument(
         "--dp-clip", type=float, default=0.0,
         help="DP-FedAvg per-trainer L2 clip bound (0 = off)",
     )
@@ -265,6 +270,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         server_lr=args.server_lr,
         server_momentum=args.server_momentum,
         fedprox_mu=args.fedprox_mu,
+        scaffold=args.scaffold,
         dp_clip=args.dp_clip,
         dp_noise_multiplier=args.dp_noise_multiplier,
         dp_delta=args.dp_delta,

@@ -100,6 +100,16 @@ class Config:
     # of ||x_i - v||); > 0 = fixed L2 radius in delta units.
     cclip_tau: float = 0.0
     cclip_iters: int = 0  # 0 => aggregators.CCLIP_ITERS (one shared default)
+    # SCAFFOLD (Karimireddy et al., ICML 2020): control variates correct
+    # client drift at every LOCAL STEP — each peer keeps c_i, the server
+    # keeps c, local steps use g + c - c_i, and after K local steps
+    # trainers refresh c_i <- c_i - c - delta/(K*lr) (option II) while the
+    # server folds the sampled trainers' control deltas into c scaled by
+    # T/N. The third drift-control family next to FedProx (proximal) and
+    # FedAvgM (server momentum). Persistent per-peer state: O(P x model)
+    # float32 for the c_i stack (like gossip's peer-stacked params — the
+    # algorithm's inherent cost, reference-less).
+    scaffold: bool = False
     # FedProx (Li et al., MLSys 2020): proximal term (mu/2)||w - w_round||^2
     # on every local step's objective, anchored at the round's incoming
     # global params — bounds client drift over multi-epoch local training
@@ -494,6 +504,41 @@ class Config:
             )
         if not (0.0 <= self.trimmed_mean_beta < 0.5):
             raise ValueError(f"trimmed_mean_beta must be in [0, 0.5), got {self.trimmed_mean_beta}")
+        if self.scaffold:
+            if self.aggregator != "fedavg":
+                raise ValueError(
+                    "scaffold requires aggregator='fedavg' (the control-"
+                    "variate update is derived for the plain trainer mean)"
+                )
+            if self.optimizer != "sgd" or self.momentum != 0.0:
+                raise ValueError(
+                    "scaffold requires plain SGD local steps (option II's "
+                    "c_i update divides the net delta by K*lr)"
+                )
+            if self.peer_chunk > 0:
+                raise ValueError(
+                    "scaffold with peer_chunk is not supported (per-peer "
+                    "control variates need per-peer deltas)"
+                )
+            if self.brb_enabled:
+                raise ValueError(
+                    "scaffold with the BRB trust plane is not yet supported"
+                )
+            if self.dp_clip > 0.0:
+                raise ValueError(
+                    "scaffold with dp_clip is not supported: the control "
+                    "variate c folds RAW pre-clip/pre-noise deltas into "
+                    "released state, bypassing the mechanism the epsilon "
+                    "accounting certifies"
+                )
+            if (
+                self.seq_shards > 1 or self.tp_shards > 1
+                or self.ep_shards > 1 or self.pp_shards > 1
+            ):
+                raise ValueError(
+                    "scaffold with model/sequence parallelism is not yet "
+                    "supported (the c_i stack placement is data-parallel)"
+                )
         if self.fedprox_mu < 0.0:
             raise ValueError(f"fedprox_mu must be >= 0 (0 = off), got {self.fedprox_mu}")
         if self.dp_clip < 0.0:

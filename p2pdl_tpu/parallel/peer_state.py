@@ -62,6 +62,11 @@ class PeerState:
     # structure — and every momentum-off code path — bit-identical to the
     # pre-FedAvgM layout).
     server_m: Any = None
+    # SCAFFOLD control variates (cfg.scaffold): ``scaffold_c`` is the
+    # server's params-shaped float32 pytree (replicated), ``scaffold_ci``
+    # the [P, ...]-stacked per-peer variates (peer-sharded). None when off.
+    scaffold_c: Any = None
+    scaffold_ci: Any = None
 
 
 def params_layout(cfg: Config) -> str:
@@ -159,12 +164,20 @@ def init_peer_state(cfg: Config, key: jax.Array | None = None) -> PeerState:
         # Float32 regardless of param dtype: the buffer accumulates small
         # aggregates across many rounds.
         server_m = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    scaffold_c = scaffold_ci = None
+    if cfg.scaffold:
+        scaffold_c = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        scaffold_ci = jax.tree.map(
+            lambda p: jnp.zeros((cfg.num_peers, *p.shape), jnp.float32), params
+        )
     return PeerState(
         params=params,
         opt_state=jax.tree.map(stack, opt_state),
         rng=jax.random.split(peer_key, cfg.num_peers),
         round_idx=jnp.zeros((), jnp.int32),
         server_m=server_m,
+        scaffold_c=scaffold_c,
+        scaffold_ci=scaffold_ci,
     )
 
 
@@ -219,6 +232,10 @@ def shard_state(state: PeerState, cfg: Config, mesh) -> PeerState:
         # The momentum buffer mirrors the params placement leaf-for-leaf
         # (same shapes, same model-parallel splits).
         server_m=None if state.server_m is None else param_shardings,
+        # SCAFFOLD: c replicated like sync params, c_i peer-stacked.
+        # (Config restricts scaffold to the data-parallel sync layout.)
+        scaffold_c=None if state.scaffold_c is None else jax.tree.map(lambda _: rs, state.scaffold_c),
+        scaffold_ci=None if state.scaffold_ci is None else jax.tree.map(lambda _: ps, state.scaffold_ci),
     )
     return jax.device_put(state, shardings)
 

@@ -220,7 +220,7 @@ def make_local_train(
     # no longer a no-op and the gather stays.)
     shuffle = not (nb == 1 and nb * b == s and ep_axis is None)
 
-    def local_train(params, opt_state, key, x, y):
+    def local_train(params, opt_state, key, x, y, grad_bias=None):
         # FedProx (Li et al., MLSys 2020): add (mu/2)||w - w_anchor||^2 to
         # every local step's objective, anchored at THIS round's incoming
         # params — bounds local drift over multi-step training on skewed
@@ -254,6 +254,12 @@ def make_local_train(
                 params, opt_state = carry
                 xb, yb = batch
                 loss, grads = step_grad(params, xb, yb)
+                if grad_bias is not None:
+                    # SCAFFOLD control-variate correction c - c_i, constant
+                    # across this round's local steps.
+                    grads = jax.tree.map(
+                        lambda g, b: g + b.astype(g.dtype), grads, grad_bias
+                    )
                 updates, opt_state = opt.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 return (params, opt_state), loss
@@ -337,6 +343,7 @@ def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
         and cfg.pp_shards == 1
         and cfg.optimizer == "sgd"
         and cfg.dp_clip == 0.0  # per-peer clipping needs per-peer deltas
+        and not cfg.scaffold  # per-peer control variates need per-peer deltas
         and cfg.momentum == 0.0
         and cfg.weight_decay == 0.0
         and cfg.local_epochs == 1
@@ -472,25 +479,55 @@ def build_round_fn(
     # (image height for ViT — the stride-aligned patch stem makes row blocks
     # independent) is additionally sharded over the seq axis.
     x_spec = P(PEER_AXIS, None, SEQ_AXIS) if seq_axis is not None else sp
-    smapped = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(params_spec, opt_spec, sp, x_spec, sp, sr, sr, sr, sr),
-        out_specs=(params_spec, opt_spec, sp) + ((sp,) if emit_delta else ()),
-    )
+    if cfg.scaffold:
+        # (params, opt, c, ci, rng, x, y, tid, byz, round, key) ->
+        # (params, opt, losses, c, ci). Config restricts scaffold to the
+        # data-parallel sync layout, so c is a plain replicated tree and
+        # the c_i stack shards like the optimizer state.
+        smapped = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(params_spec, opt_spec, P(), sp, sp, x_spec, sp, sr, sr, sr, sr),
+            out_specs=(params_spec, opt_spec, sp, P(), sp),
+        )
+    else:
+        smapped = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(params_spec, opt_spec, sp, x_spec, sp, sr, sr, sr, sr),
+            out_specs=(params_spec, opt_spec, sp) + ((sp,) if emit_delta else ()),
+        )
 
     def round_fn(state: PeerState, x, y, trainer_idx, byz_gate, mask_key):
-        out = smapped(
-            state.params,
-            state.opt_state,
-            state.rng,
-            x,
-            y,
-            trainer_idx,
-            byz_gate,
-            state.round_idx,
-            mask_key,
-        )
+        if cfg.scaffold:
+            new_params, new_opt, losses, new_c, new_ci = smapped(
+                state.params,
+                state.opt_state,
+                state.scaffold_c,
+                state.scaffold_ci,
+                state.rng,
+                x,
+                y,
+                trainer_idx,
+                byz_gate,
+                state.round_idx,
+                mask_key,
+            )
+            out = (new_params, new_opt, losses)
+            scaffold_c, scaffold_ci = new_c, new_ci
+        else:
+            out = smapped(
+                state.params,
+                state.opt_state,
+                state.rng,
+                x,
+                y,
+                trainer_idx,
+                byz_gate,
+                state.round_idx,
+                mask_key,
+            )
+            scaffold_c, scaffold_ci = state.scaffold_c, state.scaffold_ci
         new_params, new_opt, losses = out[:3]
         metrics = {"train_loss": losses}
         if emit_delta:
@@ -506,6 +543,8 @@ def build_round_fn(
             rng=state.rng,
             round_idx=state.round_idx + 1,
             server_m=server_m,
+            scaffold_c=scaffold_c,
+            scaffold_ci=scaffold_ci,
         )
         return new_state, metrics
 
@@ -536,6 +575,11 @@ def build_multi_round_fn(
     """
     if cfg.brb_enabled:
         raise ValueError("fused rounds cannot host the BRB trust plane between phases")
+    if cfg.scaffold:
+        raise ValueError(
+            "fused rounds with SCAFFOLD are not yet supported (the control-"
+            "variate state would need to thread the fused scan carry)"
+        )
     pair_seeds = _resolve_pair_seeds(cfg, pair_seeds)
     seq_axis, tp_axis, ep_axis, pp_axis = _mesh_axes_for(cfg, mesh)
     model = build_model(
@@ -897,14 +941,20 @@ def _fast_sync_body(cfg, model, l_per_dev):
     return body
 
 
-def _local_train_phase(cfg, attack, model, opt, l_per_dev, seq_axis=None, ep_axis=None):
+def _local_train_phase(
+    cfg, attack, model, opt, l_per_dev, seq_axis=None, ep_axis=None, with_bias=False
+):
     """Phase fragment (inside ``shard_map``): every peer's local SGD from the
     replicated global params, returning the (possibly attacked) per-peer
     deltas — the round up to the point where the reference's trainer ships
-    its update (reference ``node/node.py:265-297``)."""
+    its update (reference ``node/node.py:265-297``).
+
+    ``with_bias=True`` (SCAFFOLD): the phase takes a per-peer gradient-bias
+    pytree (``[L, ...]`` leaves, the ``c - c_i`` correction) vmapped into
+    every local step."""
     local_train = make_local_train(cfg, model, opt, seq_axis=seq_axis, ep_axis=ep_axis)
 
-    def phase(params, opt_state, rng, x, y, byz_gate, round_idx, mask_key):
+    def phase(params, opt_state, rng, x, y, byz_gate, round_idx, mask_key, grad_bias=None):
         dev = lax.axis_index(PEER_AXIS)
         local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
         round_keys = jax.vmap(lambda k: jax.random.fold_in(k, round_idx))(rng)
@@ -918,8 +968,8 @@ def _local_train_phase(cfg, attack, model, opt, l_per_dev, seq_axis=None, ep_axi
         # leaves enter ep-varying via their P(ep) placement and stay so).
         pvaried = jax.lax.pcast(params, PEER_AXIS, to="varying")
         new_params, new_opt, losses = jax.vmap(
-            local_train, in_axes=(None, 0, 0, 0, 0)
-        )(pvaried, opt_state, round_keys, x, y)
+            local_train, in_axes=(None, 0, 0, 0, 0, 0 if with_bias else None)
+        )(pvaried, opt_state, round_keys, x, y, grad_bias)
 
         if ep_axis is not None:
             # local_train reports its 1/ep-scaled shard-slice loss mean;
@@ -1305,9 +1355,56 @@ def _general_sync_body(
     aggregate trainer deltas, apply one deterministic server update. One
     fused program = the two phase fragments composed with no host boundary."""
     train = _local_train_phase(
-        cfg, attack, model, opt, l_per_dev, seq_axis=seq_axis, ep_axis=ep_axis
+        cfg, attack, model, opt, l_per_dev,
+        seq_axis=seq_axis, ep_axis=ep_axis, with_bias=cfg.scaffold,
     )
     agg = _aggregate_phase(cfg, l_per_dev, pair_seeds=pair_seeds)
+
+    if cfg.scaffold:
+        # SCAFFOLD (Karimireddy et al. 2020, option II). Per round:
+        #   local steps:  w <- w - lr*(g + c - c_i)   (grad bias, constant)
+        #   trainers:     c_i <- c_i - c - delta_i / (K*lr)
+        #   server:       c   <- c + (T_live/N) * mean_trainers(c_i' - c_i)
+        # The c_i update uses the POST-attack delta — a Byzantine peer
+        # corrupts its control history exactly as it corrupts its update.
+        k_steps = cfg.local_epochs * cfg.batches_per_epoch
+        inv_klr = 1.0 / (k_steps * cfg.lr)
+        n_total = float(cfg.num_peers)
+
+        def body(params, opt_state, sc_c, sc_ci, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
+            dev = lax.axis_index(PEER_AXIS)
+            local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
+            is_trainer = jnp.isin(local_ids, trainer_idx)
+            bias = jax.tree.map(lambda c, ci: c[None] - ci, sc_c, sc_ci)
+            delta, new_opt, losses = train(
+                params, opt_state, rng, x, y, byz_gate, round_idx, mask_key, bias
+            )
+            new_p, kept_opt = agg(
+                params, opt_state, new_opt, delta, trainer_idx, mask_key, round_idx
+            )
+            count = jnp.maximum(
+                lax.psum(jnp.sum(is_trainer.astype(jnp.float32)), PEER_AXIS), 1.0
+            )
+
+            def upd(c, ci, d):
+                gate = is_trainer.astype(jnp.float32).reshape(
+                    (l_per_dev,) + (1,) * (d.ndim - 1)
+                )
+                dci = -c[None] - d.astype(jnp.float32) * inv_klr  # c_i' - c_i
+                new_ci = ci + gate * dci
+                mean_dci = lax.psum(jnp.sum(gate * dci, axis=0), PEER_AXIS) / count
+                new_c = c + (count / n_total) * mean_dci
+                return new_c, new_ci
+
+            flat_c, treedef = jax.tree_util.tree_flatten(sc_c)
+            flat_ci = jax.tree.leaves(sc_ci)
+            flat_d = jax.tree.leaves(delta)
+            outs = [upd(c, ci, d) for c, ci, d in zip(flat_c, flat_ci, flat_d)]
+            new_c = jax.tree_util.tree_unflatten(treedef, [o[0] for o in outs])
+            new_ci = jax.tree_util.tree_unflatten(treedef, [o[1] for o in outs])
+            return new_p, kept_opt, losses, new_c, new_ci
+
+        return body
 
     def body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
         delta, new_opt, losses = train(

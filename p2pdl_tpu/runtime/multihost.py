@@ -237,6 +237,12 @@ def shard_peer_state(state, cfg: Config, topo: HostTopology, mesh):
         server_m=None
         if state.server_m is None
         else jax.tree.map(put_rep, state.server_m),
+        scaffold_c=None
+        if state.scaffold_c is None
+        else jax.tree.map(put_rep, state.scaffold_c),
+        scaffold_ci=None
+        if state.scaffold_ci is None
+        else jax.tree.map(put_peer, state.scaffold_ci),
     )
 
 

@@ -38,10 +38,14 @@ def _state_to_tree(state: PeerState) -> dict[str, Any]:
         "rng": state.rng,
         "round_idx": state.round_idx,
     }
-    # Only materialized when FedAvgM is on — a momentum-off checkpoint keeps
-    # the pre-FedAvgM tree byte-for-byte (old checkpoints stay loadable).
+    # Optional-feature state only materializes when enabled — a
+    # features-off checkpoint keeps the original tree byte-for-byte (old
+    # checkpoints stay loadable).
     if state.server_m is not None:
         tree["server_m"] = state.server_m
+    if state.scaffold_c is not None:
+        tree["scaffold_c"] = state.scaffold_c
+        tree["scaffold_ci"] = state.scaffold_ci
     return tree
 
 
@@ -52,6 +56,8 @@ def _tree_to_state(tree: dict[str, Any]) -> PeerState:
         rng=tree["rng"],
         round_idx=tree["round_idx"],
         server_m=tree.get("server_m"),
+        scaffold_c=tree.get("scaffold_c"),
+        scaffold_ci=tree.get("scaffold_ci"),
     )
 
 

@@ -1,0 +1,153 @@
+"""SCAFFOLD (Karimireddy et al., ICML 2020): control-variate drift correction.
+
+Per-peer ``c_i`` + server ``c``; local steps use ``g + c - c_i``; option-II
+refresh ``c_i <- c_i - c - delta/(K*lr)`` for sampled trainers; server
+``c <- c + (T/N) * mean(c_i' - c_i)``. Third drift-control family next to
+FedProx and FedAvgM. The reference has no drift control of any kind
+(``/root/reference/training/train.py:3-26``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.data import make_federated_data
+from p2pdl_tpu.parallel import (
+    build_eval_fn,
+    build_multi_round_fn,
+    build_round_fn,
+    init_peer_state,
+    peer_sharding,
+    shard_state,
+)
+
+CFG = dict(
+    num_peers=8,
+    trainers_per_round=4,
+    local_epochs=2,
+    samples_per_peer=64,
+    batch_size=32,
+    lr=0.05,
+    server_lr=1.0,
+    model="mlp",
+    dataset="mnist",
+    partition="dirichlet",
+    dirichlet_alpha=0.1,
+    compute_dtype="float32",
+)
+
+
+def _setup(cfg, mesh8):
+    data = make_federated_data(cfg, eval_samples=256)
+    state = shard_state(init_peer_state(cfg), cfg, mesh8)
+    sh = peer_sharding(mesh8)
+    x = jax.device_put(data.x, sh)
+    y = jax.device_put(data.y, sh)
+    return data, state, x, y, build_round_fn(cfg, mesh8)
+
+
+def test_first_round_params_equal_fedavg(mesh8):
+    """c and every c_i start at zero, so round 1's bias is zero: params
+    after one round match plain FedAvg exactly (the control state, not
+    the trajectory, is what differs after round 1)."""
+    tid = jnp.asarray([0, 2, 5, 7], jnp.int32)
+    _, s0, x, y, fn0 = _setup(Config(**CFG), mesh8)
+    s0, _ = fn0(s0, x, y, tid, jnp.zeros(8), jax.random.PRNGKey(0))
+    _, s1, x1, y1, fn1 = _setup(Config(**CFG, scaffold=True), mesh8)
+    s1, _ = fn1(s1, x1, y1, tid, jnp.zeros(8), jax.random.PRNGKey(0))
+    for a, b in zip(jax.tree.leaves(s0.params), jax.tree.leaves(s1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_control_variate_update_math(mesh8):
+    """Round-1 bookkeeping against the option-II formulas: with c = c_i = 0,
+    trainers get c_i' = -delta_i/(K*lr); non-trainers keep c_i = 0; and
+    c' = (T_live/N) * mean_trainers(c_i' - c_i)."""
+    cfg = Config(**CFG, scaffold=True)
+    tid = jnp.asarray([0, 2, 5, 7], jnp.int32)
+    _, state, x, y, fn = _setup(cfg, mesh8)
+    p_before = jax.tree.leaves(init_peer_state(cfg).params)
+    state, _ = fn(state, x, y, tid, jnp.zeros(8), jax.random.PRNGKey(0))
+    k_lr = cfg.local_epochs * cfg.batches_per_epoch * cfg.lr
+    # Aggregate = mean over the 4 trainers of delta; server_lr=1 =>
+    # mean(delta) = p_after - p_before. And mean(c_i') over trainers =
+    # -mean(delta)/(K*lr), so c' = (4/8) * that.
+    for p0, p1, c, ci in zip(
+        p_before,
+        jax.tree.leaves(state.params),
+        jax.tree.leaves(state.scaffold_c),
+        jax.tree.leaves(state.scaffold_ci),
+    ):
+        mean_delta = np.asarray(p1, np.float64) - np.asarray(p0, np.float64)
+        want_c = -(4 / 8) * mean_delta / k_lr
+        np.testing.assert_allclose(np.asarray(c), want_c, atol=1e-5)
+        ci = np.asarray(ci)
+        for peer in (1, 3, 4, 6):  # non-trainers untouched
+            np.testing.assert_array_equal(ci[peer], np.zeros_like(ci[peer]))
+        # Trainers' c_i' average to -mean(delta)/(K*lr).
+        np.testing.assert_allclose(
+            ci[[0, 2, 5, 7]].mean(0), -mean_delta / k_lr, atol=1e-5
+        )
+
+
+def test_scaffold_changes_round_two(mesh8):
+    """From round 2 the nonzero control variates bias every local step —
+    a real trajectory change vs FedAvg."""
+    tid = jnp.arange(4, dtype=jnp.int32)
+    _, s0, x, y, fn0 = _setup(Config(**CFG), mesh8)
+    _, s1, x1, y1, fn1 = _setup(Config(**CFG, scaffold=True), mesh8)
+    for _ in range(3):
+        s0, _ = fn0(s0, x, y, tid, jnp.zeros(8), jax.random.PRNGKey(0))
+        s1, _ = fn1(s1, x1, y1, tid, jnp.zeros(8), jax.random.PRNGKey(0))
+    diff = max(
+        float(jnp.max(jnp.abs(a - b)))
+        for a, b in zip(jax.tree.leaves(s0.params), jax.tree.leaves(s1.params))
+    )
+    assert diff > 1e-4, diff
+
+
+def test_scaffold_learns_non_iid(mesh8):
+    cfg = Config(**CFG, scaffold=True)
+    data, state, x, y, fn = _setup(cfg, mesh8)
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        t = jnp.asarray(np.sort(rng.choice(8, 4, replace=False)), jnp.int32)
+        state, _ = fn(state, x, y, t, jnp.zeros(8), jax.random.PRNGKey(0))
+    acc = float(
+        jnp.mean(build_eval_fn(cfg)(state, data.eval_x, data.eval_y)["eval_acc"])
+    )
+    assert acc > 0.85, acc
+
+
+def test_checkpoint_roundtrip(tmp_path, mesh8):
+    from p2pdl_tpu.utils.checkpoint import Checkpointer
+
+    cfg = Config(**CFG, scaffold=True)
+    _, state, x, y, fn = _setup(cfg, mesh8)
+    tid = jnp.arange(4, dtype=jnp.int32)
+    state, _ = fn(state, x, y, tid, jnp.zeros(8), jax.random.PRNGKey(0))
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(state, cfg)
+    restored = ckpt.restore(cfg)
+    for field in ("params", "scaffold_c", "scaffold_ci"):
+        for a, b in zip(
+            jax.tree.leaves(getattr(state, field)),
+            jax.tree.leaves(getattr(restored, field)),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_validation_and_fused_gate(mesh8):
+    with pytest.raises(ValueError, match="fedavg"):
+        Config(**CFG, scaffold=True, aggregator="median")
+    with pytest.raises(ValueError, match="SGD"):
+        Config(**CFG, scaffold=True, momentum=0.9)
+    with pytest.raises(ValueError, match="SCAFFOLD"):
+        build_multi_round_fn(Config(**CFG, scaffold=True), mesh8)
+
+
+def test_scaffold_rejects_dp():
+    with pytest.raises(ValueError, match="pre-clip"):
+        Config(**CFG, scaffold=True, dp_clip=1.0)
