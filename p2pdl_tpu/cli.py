@@ -48,6 +48,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="FedProx proximal coefficient (0 = plain FedAvg local objective)",
     )
     p.add_argument(
+        "--compress", choices=("none", "topk"), default="none",
+        help="EF top-k update sparsification (ship only the largest "
+        "compress-ratio fraction of each delta; unsent mass carries in a "
+        "per-peer residual)",
+    )
+    p.add_argument(
+        "--compress-ratio", type=float, default=0.1,
+        help="fraction of coordinates kept per shipped update, in (0, 1] "
+        "(only with --compress topk)",
+    )
+    p.add_argument(
         "--scaffold", action="store_true",
         help="SCAFFOLD control variates (per-peer c_i + server c correct "
         "client drift at every local step; plain-SGD fedavg only)",
@@ -271,6 +282,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         server_momentum=args.server_momentum,
         fedprox_mu=args.fedprox_mu,
         scaffold=args.scaffold,
+        compress=args.compress,
+        compress_ratio=args.compress_ratio,
         dp_clip=args.dp_clip,
         dp_noise_multiplier=args.dp_noise_multiplier,
         dp_delta=args.dp_delta,

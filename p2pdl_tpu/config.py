@@ -100,6 +100,14 @@ class Config:
     # of ||x_i - v||); > 0 = fixed L2 radius in delta units.
     cclip_tau: float = 0.0
     cclip_iters: int = 0  # 0 => aggregators.CCLIP_ITERS (one shared default)
+    # Update compression with error feedback (EF-SGD, Stich et al. 2018 /
+    # Karimireddy et al. 2019): each trainer ships only the top-k fraction
+    # of its delta's coordinates (by magnitude, over the full flattened
+    # update) and carries the unsent remainder in a per-peer residual that
+    # is added back before the next round's selection — the telescoping
+    # that makes aggressive sparsification converge. "none" = off.
+    compress: str = "none"  # "none" | "topk"
+    compress_ratio: float = 0.1  # fraction of coordinates kept per update
     # SCAFFOLD (Karimireddy et al., ICML 2020): control variates correct
     # client drift at every LOCAL STEP — each peer keeps c_i, the server
     # keeps c, local steps use g + c - c_i, and after K local steps
@@ -504,6 +512,48 @@ class Config:
             )
         if not (0.0 <= self.trimmed_mean_beta < 0.5):
             raise ValueError(f"trimmed_mean_beta must be in [0, 0.5), got {self.trimmed_mean_beta}")
+        if self.compress not in ("none", "topk"):
+            raise ValueError(
+                f"unknown compress {self.compress!r}; one of ('none', 'topk')"
+            )
+        if self.compress != "none":
+            if not (0.0 < self.compress_ratio <= 1.0):
+                raise ValueError(
+                    f"compress_ratio must be in (0, 1], got {self.compress_ratio}"
+                )
+            if self.aggregator in ("gossip",):
+                raise ValueError(
+                    "compress applies to shipped trainer deltas; gossip "
+                    "mixes params, not deltas"
+                )
+            if self.peer_chunk > 0:
+                raise ValueError(
+                    "compress with peer_chunk is not supported (the per-peer "
+                    "error-feedback residual needs per-peer deltas)"
+                )
+            if self.brb_enabled:
+                raise ValueError(
+                    "compress with the BRB trust plane is not yet supported"
+                )
+            if self.scaffold:
+                raise ValueError(
+                    "compress with scaffold is not yet supported (two "
+                    "independent per-peer state threads)"
+                )
+            if self.dp_clip > 0.0:
+                raise ValueError(
+                    "compress with dp_clip is not supported: top-k selection "
+                    "is data-dependent per coordinate and the clip/noise "
+                    "calibration does not cover it"
+                )
+            if (
+                self.seq_shards > 1 or self.tp_shards > 1
+                or self.ep_shards > 1 or self.pp_shards > 1
+            ):
+                raise ValueError(
+                    "compress with model/sequence parallelism is not yet "
+                    "supported (the residual placement is data-parallel)"
+                )
         if self.scaffold:
             if self.aggregator != "fedavg":
                 raise ValueError(

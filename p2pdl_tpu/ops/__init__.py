@@ -9,6 +9,7 @@ tensor-parallel placement, mixture-of-experts dispatch."""
 from p2pdl_tpu.ops.moe import MoEFFN, top1_route
 from p2pdl_tpu.ops.gossip import exp_mix, ring_mix
 from p2pdl_tpu.ops.pipeline import PipelinedBlocks
+from p2pdl_tpu.ops.compression import topk_ef
 from p2pdl_tpu.ops.aggregators import (
     bulyan,
     centered_clip,
@@ -33,6 +34,7 @@ from p2pdl_tpu.ops.sharded_aggregators import (
 )
 
 __all__ = [
+    "topk_ef",
     "bulyan",
     "bulyan_sharded",
     "centered_clip",

@@ -67,6 +67,9 @@ class PeerState:
     # the [P, ...]-stacked per-peer variates (peer-sharded). None when off.
     scaffold_c: Any = None
     scaffold_ci: Any = None
+    # Error-feedback residual (cfg.compress != "none"): [P, ...]-stacked
+    # float32 unsent remainders, peer-sharded. None when off.
+    compress_err: Any = None
 
 
 def params_layout(cfg: Config) -> str:
@@ -170,6 +173,11 @@ def init_peer_state(cfg: Config, key: jax.Array | None = None) -> PeerState:
         scaffold_ci = jax.tree.map(
             lambda p: jnp.zeros((cfg.num_peers, *p.shape), jnp.float32), params
         )
+    compress_err = None
+    if cfg.compress != "none":
+        compress_err = jax.tree.map(
+            lambda p: jnp.zeros((cfg.num_peers, *p.shape), jnp.float32), params
+        )
     return PeerState(
         params=params,
         opt_state=jax.tree.map(stack, opt_state),
@@ -178,6 +186,7 @@ def init_peer_state(cfg: Config, key: jax.Array | None = None) -> PeerState:
         server_m=server_m,
         scaffold_c=scaffold_c,
         scaffold_ci=scaffold_ci,
+        compress_err=compress_err,
     )
 
 
@@ -236,6 +245,7 @@ def shard_state(state: PeerState, cfg: Config, mesh) -> PeerState:
         # (Config restricts scaffold to the data-parallel sync layout.)
         scaffold_c=None if state.scaffold_c is None else jax.tree.map(lambda _: rs, state.scaffold_c),
         scaffold_ci=None if state.scaffold_ci is None else jax.tree.map(lambda _: ps, state.scaffold_ci),
+        compress_err=None if state.compress_err is None else jax.tree.map(lambda _: ps, state.compress_err),
     )
     return jax.device_put(state, shardings)
 

@@ -344,6 +344,7 @@ def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
         and cfg.optimizer == "sgd"
         and cfg.dp_clip == 0.0  # per-peer clipping needs per-peer deltas
         and not cfg.scaffold  # per-peer control variates need per-peer deltas
+        and cfg.compress == "none"  # EF residuals need per-peer deltas
         and cfg.momentum == 0.0
         and cfg.weight_decay == 0.0
         and cfg.local_epochs == 1
@@ -490,6 +491,16 @@ def build_round_fn(
             in_specs=(params_spec, opt_spec, P(), sp, sp, x_spec, sp, sr, sr, sr, sr),
             out_specs=(params_spec, opt_spec, sp, P(), sp),
         )
+    elif cfg.compress != "none":
+        # (params, opt, err, rng, x, y, tid, byz, round, key) ->
+        # (params, opt, losses, err). The residual stack shards like the
+        # optimizer state (data-parallel sync layout, config-enforced).
+        smapped = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(params_spec, opt_spec, sp, sp, x_spec, sp, sr, sr, sr, sr),
+            out_specs=(params_spec, opt_spec, sp, sp),
+        )
     else:
         smapped = jax.shard_map(
             body,
@@ -515,6 +526,22 @@ def build_round_fn(
             )
             out = (new_params, new_opt, losses)
             scaffold_c, scaffold_ci = new_c, new_ci
+            compress_err = state.compress_err
+        elif cfg.compress != "none":
+            new_params, new_opt, losses, compress_err = smapped(
+                state.params,
+                state.opt_state,
+                state.compress_err,
+                state.rng,
+                x,
+                y,
+                trainer_idx,
+                byz_gate,
+                state.round_idx,
+                mask_key,
+            )
+            out = (new_params, new_opt, losses)
+            scaffold_c, scaffold_ci = state.scaffold_c, state.scaffold_ci
         else:
             out = smapped(
                 state.params,
@@ -528,6 +555,7 @@ def build_round_fn(
                 mask_key,
             )
             scaffold_c, scaffold_ci = state.scaffold_c, state.scaffold_ci
+            compress_err = state.compress_err
         new_params, new_opt, losses = out[:3]
         metrics = {"train_loss": losses}
         if emit_delta:
@@ -545,6 +573,7 @@ def build_round_fn(
             server_m=server_m,
             scaffold_c=scaffold_c,
             scaffold_ci=scaffold_ci,
+            compress_err=compress_err,
         )
         return new_state, metrics
 
@@ -579,6 +608,11 @@ def build_multi_round_fn(
         raise ValueError(
             "fused rounds with SCAFFOLD are not yet supported (the control-"
             "variate state would need to thread the fused scan carry)"
+        )
+    if cfg.compress != "none":
+        raise ValueError(
+            "fused rounds with compression are not yet supported (the "
+            "error-feedback residual would need to thread the fused scan carry)"
         )
     pair_seeds = _resolve_pair_seeds(cfg, pair_seeds)
     seq_axis, tp_axis, ep_axis, pp_axis = _mesh_axes_for(cfg, mesh)
@@ -1359,6 +1393,39 @@ def _general_sync_body(
         seq_axis=seq_axis, ep_axis=ep_axis, with_bias=cfg.scaffold,
     )
     agg = _aggregate_phase(cfg, l_per_dev, pair_seeds=pair_seeds)
+
+    if cfg.compress != "none":
+        # EF top-k sparsification (ops/compression.py). Per round:
+        #   v_i = delta_i + err_i; ship top-k(v_i); err_i' = v_i - sent_i.
+        # Only TRAINERS consume and refresh their residual (non-trainers'
+        # deltas are discarded whole, so their unsent mass must not
+        # accumulate); the attack epilogue ran inside the train phase, so
+        # an attacker ships the sparsified form of its corrupted update.
+        from p2pdl_tpu.ops.compression import topk_ef
+
+        def body(params, opt_state, err, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
+            dev = lax.axis_index(PEER_AXIS)
+            local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
+            is_trainer = jnp.isin(local_ids, trainer_idx)
+            delta, new_opt, losses = train(
+                params, opt_state, rng, x, y, byz_gate, round_idx, mask_key
+            )
+            sent, new_err = topk_ef(delta, err, cfg.compress_ratio)
+
+            def keep_trainers(n, o):
+                m = is_trainer.reshape((l_per_dev,) + (1,) * (n.ndim - 1))
+                return jnp.where(m, n, o)
+
+            new_err = jax.tree.map(keep_trainers, new_err, err)
+            sent = jax.tree.map(
+                lambda s, d: s.astype(d.dtype), sent, delta
+            )
+            new_p, kept_opt = agg(
+                params, opt_state, new_opt, sent, trainer_idx, mask_key, round_idx
+            )
+            return new_p, kept_opt, losses, new_err
+
+        return body
 
     if cfg.scaffold:
         # SCAFFOLD (Karimireddy et al. 2020, option II). Per round:

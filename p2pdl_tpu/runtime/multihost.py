@@ -243,6 +243,9 @@ def shard_peer_state(state, cfg: Config, topo: HostTopology, mesh):
         scaffold_ci=None
         if state.scaffold_ci is None
         else jax.tree.map(put_peer, state.scaffold_ci),
+        compress_err=None
+        if state.compress_err is None
+        else jax.tree.map(put_peer, state.compress_err),
     )
 
 

@@ -46,6 +46,8 @@ def _state_to_tree(state: PeerState) -> dict[str, Any]:
     if state.scaffold_c is not None:
         tree["scaffold_c"] = state.scaffold_c
         tree["scaffold_ci"] = state.scaffold_ci
+    if state.compress_err is not None:
+        tree["compress_err"] = state.compress_err
     return tree
 
 
@@ -58,6 +60,7 @@ def _tree_to_state(tree: dict[str, Any]) -> PeerState:
         server_m=tree.get("server_m"),
         scaffold_c=tree.get("scaffold_c"),
         scaffold_ci=tree.get("scaffold_ci"),
+        compress_err=tree.get("compress_err"),
     )
 
 
