@@ -21,6 +21,7 @@ from p2pdl_tpu.parallel.round import (
     build_eval_fn,
     build_multi_round_fn,
     build_per_peer_eval_fn,
+    build_personalized_eval_fn,
     build_round_fn,
     build_gossip_trust_round_fns,
     build_trust_round_fns,
@@ -41,4 +42,5 @@ __all__ = [
     "build_trust_round_fns",
     "build_eval_fn",
     "build_per_peer_eval_fn",
+    "build_personalized_eval_fn",
 ]

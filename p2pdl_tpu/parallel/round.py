@@ -1526,6 +1526,87 @@ def build_per_peer_eval_fn(cfg: Config, mesh: Mesh) -> Callable:
     return eval_fn
 
 
+def build_personalized_eval_fn(
+    cfg: Config, mesh: Mesh, finetune_steps: int = 1
+) -> Callable:
+    """Personalized accuracy: each peer fine-tunes the global model on its
+    OWN training shard for ``finetune_steps`` epochs of plain local SGD,
+    then evaluates the personalized copy on its own shard —
+    ``(state, x, y) -> [num_peers]`` accuracies.
+
+    The canonical personalization baseline of the FL literature (FedAvg +
+    local fine-tuning — the protocol Ditto, Li et al. 2021 evaluates
+    against): it answers "how good is the global model as a STARTING
+    POINT for my data", which on non-IID shards can diverge sharply from
+    the global accuracy. Like :func:`build_per_peer_eval_fn` (the
+    reference's own-shard protocol, ``evaluation/evaluation.py:10``) the
+    score is measured on the peer's own shard — the two functions differ
+    exactly by the fine-tuning step, so their difference isolates the
+    personalization gain. The fine-tuned copies are transient — the
+    experiment's state is untouched. Sync layout only (gossip peers
+    already keep personal models)."""
+    if params_layout(cfg) != "sync":
+        raise ValueError(
+            "personalized eval is for the sync layout; gossip peers already "
+            "hold personal models (use build_per_peer_eval_fn)"
+        )
+    if (
+        cfg.seq_shards > 1 or cfg.tp_shards > 1
+        or cfg.ep_shards > 1 or cfg.pp_shards > 1
+    ):
+        raise ValueError(
+            "personalized eval does not support model/sequence parallelism "
+            "(the fine-tune body is data-parallel; the TP bias pre-scale "
+            "would corrupt its dense-twin gradients)"
+        )
+    # The BASELINE fine-tune is plain local SGD from the global model with
+    # FRESH (empty) optimizer state: inheriting the experiment's FedProx
+    # anchor would pull the personalized copy back toward the global model
+    # (understating the gain this metric isolates), and stale Adam/momentum
+    # buffers would distort the first steps.
+    ft_cfg = cfg.replace(
+        local_epochs=finetune_steps,
+        fedprox_mu=0.0,
+        optimizer="sgd",
+        momentum=0.0,
+        weight_decay=0.0,
+    )
+    model = build_model(ft_cfg)
+    opt = make_optimizer(ft_cfg)
+    local_train = make_local_train(ft_cfg, model, opt)
+    forward = make_forward_fn(model, jnp.dtype(cfg.compute_dtype))
+
+    def body(params, rng, x, y):
+        params_v = jax.lax.pcast(params, PEER_AXIS, to="varying")
+
+        def one(key, xp, yp):
+            p, _, _ = local_train(params_v, opt.init(params_v), key, xp, yp)
+            logits = forward(p, xp)
+            return jnp.mean(jnp.argmax(logits, axis=-1) == yp)
+
+        if cfg.peer_chunk > 0:
+            # The config that needed delta streaming to fit training would
+            # OOM on l_per_dev simultaneous fine-tune instances — run the
+            # local peers sequentially instead (eval-path latency for
+            # round-path memory parity).
+            return jax.lax.map(lambda a: one(*a), (rng, x, y))
+        return jax.vmap(one)(rng, x, y)
+
+    sp = P(PEER_AXIS)
+    smapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), sp, sp, sp),
+        out_specs=sp,
+    )
+
+    @jax.jit
+    def eval_fn(state: PeerState, x, y):
+        return smapped(state.params, state.rng, x, y)
+
+    return eval_fn
+
+
 def build_eval_fn(cfg: Config) -> Callable:
     """Held-out evaluation of the synchronized global model.
 

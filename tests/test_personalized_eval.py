@@ -1,0 +1,105 @@
+"""Personalized evaluation: global model + k fine-tune epochs on the
+peer's own shard (the FedAvg+fine-tune baseline of Ditto, Li et al. 2021).
+Differs from build_per_peer_eval_fn (reference own-shard protocol,
+/root/reference/evaluation/evaluation.py:10) exactly by the fine-tune step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.data import make_federated_data
+from p2pdl_tpu.parallel import (
+    build_per_peer_eval_fn,
+    build_personalized_eval_fn,
+    build_round_fn,
+    init_peer_state,
+    peer_sharding,
+    shard_state,
+)
+
+CFG = dict(
+    num_peers=8, trainers_per_round=8, local_epochs=2, samples_per_peer=64,
+    batch_size=32, lr=0.05, server_lr=1.0, model="mlp", dataset="mnist",
+    partition="dirichlet", dirichlet_alpha=0.1, compute_dtype="float32",
+)
+
+
+def _trained_state(cfg, mesh8, rounds=2):
+    data = make_federated_data(cfg, eval_samples=16)
+    state = shard_state(init_peer_state(cfg), cfg, mesh8)
+    sh = peer_sharding(mesh8)
+    x = jax.device_put(data.x, sh)
+    y = jax.device_put(data.y, sh)
+    fn = build_round_fn(cfg, mesh8)
+    tid = jnp.arange(8, dtype=jnp.int32)
+    for _ in range(rounds):
+        state, _ = fn(state, x, y, tid, jnp.zeros(8), jax.random.PRNGKey(0))
+    return state, x, y
+
+
+def test_personalization_beats_global_on_skewed_shards(mesh8):
+    """On alpha=0.1 Dirichlet shards, fine-tuning on the own shard must
+    raise mean own-shard accuracy vs the raw global model, and the state
+    must be untouched (transient copies only)."""
+    cfg = Config(**CFG)
+    state, x, y = _trained_state(cfg, mesh8)
+    p_before = [np.asarray(l).copy() for l in jax.tree.leaves(state.params)]
+    base = np.asarray(build_per_peer_eval_fn(cfg, mesh8)(state, x, y))
+    pers = np.asarray(build_personalized_eval_fn(cfg, mesh8, finetune_steps=2)(state, x, y))
+    assert pers.shape == (8,)
+    assert pers.mean() >= base.mean(), (pers.mean(), base.mean())
+    assert pers.mean() > base.mean() + 0.01 or base.mean() > 0.99, (pers, base)
+    for before, after in zip(p_before, jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(before, np.asarray(after))
+
+
+def test_gossip_layout_rejected(mesh8):
+    cfg = Config(
+        num_peers=8, trainers_per_round=8, model="mlp", dataset="mnist",
+        aggregator="gossip",
+    )
+    with pytest.raises(ValueError, match="sync layout"):
+        build_personalized_eval_fn(cfg, mesh8)
+
+
+def test_model_parallel_rejected(mesh8):
+    cfg = Config(
+        num_peers=4, trainers_per_round=2, model="vit_tiny", dataset="cifar10",
+        vit_pool="mean", vit_heads=4, vit_depth=2, tp_shards=2,
+    )
+    with pytest.raises(ValueError, match="model/sequence parallelism"):
+        build_personalized_eval_fn(cfg, mesh8)
+
+
+def test_baseline_is_plain_sgd_even_under_fedprox_adam(mesh8):
+    """The fine-tune must NOT inherit the experiment's FedProx anchor or
+    Adam state — identical personalized scores whether the experiment
+    trained with plain SGD or FedProx (same global params by round 1 with
+    single-step locals... use the same state object to isolate)."""
+    cfg_plain = Config(**CFG)
+    state, x, y = _trained_state(cfg_plain, mesh8)
+    pe_plain = np.asarray(
+        build_personalized_eval_fn(cfg_plain, mesh8, finetune_steps=2)(state, x, y)
+    )
+    # Same trained state evaluated under a FedProx-configured experiment:
+    # the metric must not change (mu is zeroed inside the eval).
+    cfg_prox = Config(**CFG, fedprox_mu=5.0)
+    pe_prox = np.asarray(
+        build_personalized_eval_fn(cfg_prox, mesh8, finetune_steps=2)(state, x, y)
+    )
+    np.testing.assert_allclose(pe_plain, pe_prox, atol=1e-6)
+
+
+def test_chunked_config_runs_sequentially(mesh8):
+    """peer_chunk configs fine-tune peers sequentially (lax.map) — same
+    numbers as the vmapped path."""
+    cfg = Config(**CFG)
+    state, x, y = _trained_state(cfg, mesh8)
+    want = np.asarray(build_personalized_eval_fn(cfg, mesh8, finetune_steps=1)(state, x, y))
+    cfg_chunk = Config(**{**CFG, "local_epochs": 1}, peer_chunk=2)
+    got = np.asarray(
+        build_personalized_eval_fn(cfg_chunk, mesh8, finetune_steps=1)(state, x, y)
+    )
+    np.testing.assert_allclose(got, want, atol=1e-6)
