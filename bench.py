@@ -564,6 +564,18 @@ def matrix_entries() -> list[dict]:
             "byz_ids": tuple(range(0, 128, 10)),
         },
         {
+            # EF top-k compression at 10% density: what the per-peer
+            # top_k selection costs on-chip next to the plain 128-peer
+            # round (the sort is the only added work; the masked ship is
+            # elementwise).
+            "name": "cifar10_cnn_128peers_topk10_ef",
+            "cfg": Config(
+                num_peers=128, trainers_per_round=32, local_epochs=1,
+                samples_per_peer=32, batch_size=32, model="simple_cnn",
+                dataset="cifar10", compress="topk", compress_ratio=0.1,
+            ),
+        },
+        {
             # Bulyan: iterative-Krum selection on the centered Gram +
             # streamed middle-slice aggregation, f=7 of 32 trainers
             # (4f+3=31 <= 32) under sign-flip — the heaviest two-stage
@@ -690,6 +702,7 @@ def matrix_jobs() -> list[str]:
         "attn_T4096",
         "cifar10_moe_vit_8peers_fedavg",
         "cifar10_cnn_128peers_cclip_alie",
+        "cifar10_cnn_128peers_topk10_ef",
         "cifar10_cnn_128peers_bulyan_signflip",
         "cifar10_cnn_128peers_geomedian_ipm",
         "cifar10_cnn_128peers_krum_10pct_byz",

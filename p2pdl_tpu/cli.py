@@ -83,6 +83,14 @@ def build_parser() -> argparse.ArgumentParser:
         "Byzantine defense use local --momentum with --aggregator "
         "centered_clip)",
     )
+    p.add_argument(
+        "--server-opt", choices=("sgd", "adam", "yogi"), default="sgd",
+        help="FedOpt server optimizer over the aggregated delta (sgd = "
+        "reference semantics; adam = FedAdam; yogi = FedYogi)",
+    )
+    p.add_argument("--server-beta1", type=float, default=0.9)
+    p.add_argument("--server-beta2", type=float, default=0.99)
+    p.add_argument("--server-eps", type=float, default=1e-3)
     p.add_argument("--model", choices=MODELS, default="mlp")
     p.add_argument("--dataset", choices=DATASETS, default="mnist")
     p.add_argument("--partition", choices=PARTITIONS, default="iid")
@@ -280,6 +288,10 @@ def config_from_args(args: argparse.Namespace) -> Config:
         weight_decay=args.weight_decay,
         server_lr=args.server_lr,
         server_momentum=args.server_momentum,
+        server_opt=args.server_opt,
+        server_beta1=args.server_beta1,
+        server_beta2=args.server_beta2,
+        server_eps=args.server_eps,
         fedprox_mu=args.fedprox_mu,
         scaffold=args.scaffold,
         compress=args.compress,

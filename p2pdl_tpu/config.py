@@ -77,6 +77,17 @@ class Config:
     # aggregator="centered_clip" — server-side momentum smooths the
     # trajectory but cannot average away a persistent collusion bias.
     server_momentum: float = 0.0
+    # FedOpt server optimizers (Reddi et al., ICLR 2021): treat the
+    # aggregated delta as a pseudo-gradient and apply an adaptive server
+    # step — "sgd" (reference semantics; + server_momentum = FedAvgM),
+    # "adam" (FedAdam: m = b1*m + (1-b1)*agg, v = b2*v + (1-b2)*agg^2,
+    # params += server_lr * m / (sqrt(v) + eps); no bias correction, per
+    # the paper's Alg. 2) or "yogi" (FedYogi: the sign-damped v update
+    # v -= (1-b2)*agg^2*sign(v - agg^2), less aggressive variance decay).
+    server_opt: str = "sgd"
+    server_beta1: float = 0.9
+    server_beta2: float = 0.99
+    server_eps: float = 1e-3  # the paper's tau; their best grid value
 
     # Model / data.
     model: str = "mlp"
@@ -264,26 +275,53 @@ class Config:
                 "momentum is an SGD knob; adam has its own betas "
                 "(set momentum=0.0 with optimizer='adam')"
             )
+        if self.server_opt not in ("sgd", "adam", "yogi"):
+            raise ValueError(
+                f"unknown server_opt {self.server_opt!r}; one of "
+                f"('sgd', 'adam', 'yogi')"
+            )
         if not (0.0 <= self.server_momentum < 1.0):
             raise ValueError(
                 f"server_momentum must be in [0, 1), got {self.server_momentum}"
             )
-        if self.server_momentum > 0.0:
+        if self.server_opt != "sgd":
+            if self.server_momentum > 0.0:
+                raise ValueError(
+                    "server_momentum is the FedAvgM (server_opt='sgd') knob; "
+                    "adam/yogi carry their own beta1"
+                )
+            if not (0.0 <= self.server_beta1 < 1.0) or not (0.0 <= self.server_beta2 < 1.0):
+                raise ValueError(
+                    f"server betas must be in [0, 1), got "
+                    f"({self.server_beta1}, {self.server_beta2})"
+                )
+            if self.server_eps <= 0.0:
+                raise ValueError(f"server_eps must be > 0, got {self.server_eps}")
+        # One guard set for EVERY stateful server optimizer (FedAvgM buffer
+        # or FedOpt m/v): the reconstruction divides by server_lr, gossip
+        # has no server, and the gated trust round applies its server
+        # update in the second program.
+        if self.server_momentum > 0.0 or self.server_opt != "sgd":
+            knob = (
+                "server_momentum"
+                if self.server_momentum > 0.0
+                else f"server_opt='{self.server_opt}'"
+            )
             if self.server_lr <= 0.0:
                 raise ValueError(
-                    "server_momentum requires server_lr > 0 (the buffer "
-                    f"update divides by it), got server_lr={self.server_lr}"
+                    f"{knob} requires server_lr > 0 (the pseudo-gradient "
+                    f"reconstruction divides by it), got {self.server_lr}"
                 )
             if self.aggregator == "gossip":
                 raise ValueError(
-                    "server_momentum requires a server update; gossip is "
-                    "decentralized (no server) — use a sync-layout aggregator"
+                    f"{knob} requires a server update; gossip is "
+                    f"decentralized (no server) — use a sync-layout aggregator"
                 )
             if self.brb_enabled:
                 raise ValueError(
-                    "server_momentum with the BRB trust plane is not yet "
-                    "supported (the gated two-program round applies its "
-                    "server update in the second program)"
+                    f"{knob} with the BRB trust plane is not yet supported "
+                    f"(the gated two-program round applies its server update "
+                    f"in the second program)"
                 )
         if self.weight_decay < 0:
             raise ValueError(f"weight_decay must be >= 0, got {self.weight_decay}")

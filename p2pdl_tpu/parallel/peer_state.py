@@ -62,6 +62,10 @@ class PeerState:
     # structure — and every momentum-off code path — bit-identical to the
     # pre-FedAvgM layout).
     server_m: Any = None
+    # Second FedOpt buffer (cfg.server_opt in ("adam", "yogi")): the
+    # adaptive variance accumulator v, params-shaped float32. None
+    # otherwise.
+    server_v: Any = None
     # SCAFFOLD control variates (cfg.scaffold): ``scaffold_c`` is the
     # server's params-shaped float32 pytree (replicated), ``scaffold_ci``
     # the [P, ...]-stacked per-peer variates (peer-sharded). None when off.
@@ -162,11 +166,13 @@ def init_peer_state(cfg: Config, key: jax.Array | None = None) -> PeerState:
 
     if params_layout(cfg) == "peer":
         params = jax.tree.map(stack, params)
-    server_m = None
-    if cfg.server_momentum > 0.0:
+    server_m = server_v = None
+    if cfg.server_momentum > 0.0 or cfg.server_opt != "sgd":
         # Float32 regardless of param dtype: the buffer accumulates small
         # aggregates across many rounds.
         server_m = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    if cfg.server_opt in ("adam", "yogi"):
+        server_v = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
     scaffold_c = scaffold_ci = None
     if cfg.scaffold:
         scaffold_c = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
@@ -184,6 +190,7 @@ def init_peer_state(cfg: Config, key: jax.Array | None = None) -> PeerState:
         rng=jax.random.split(peer_key, cfg.num_peers),
         round_idx=jnp.zeros((), jnp.int32),
         server_m=server_m,
+        server_v=server_v,
         scaffold_c=scaffold_c,
         scaffold_ci=scaffold_ci,
         compress_err=compress_err,
@@ -241,6 +248,7 @@ def shard_state(state: PeerState, cfg: Config, mesh) -> PeerState:
         # The momentum buffer mirrors the params placement leaf-for-leaf
         # (same shapes, same model-parallel splits).
         server_m=None if state.server_m is None else param_shardings,
+        server_v=None if state.server_v is None else param_shardings,
         # SCAFFOLD: c replicated like sync params, c_i peer-stacked.
         # (Config restricts scaffold to the data-parallel sync layout.)
         scaffold_c=None if state.scaffold_c is None else jax.tree.map(lambda _: rs, state.scaffold_c),

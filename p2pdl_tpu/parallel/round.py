@@ -413,6 +413,47 @@ def _apply_server_momentum(cfg: Config, old_params, new_params, m):
     return out_p, new_m
 
 
+def _apply_server_opt(cfg: Config, old_params, new_params, m, v):
+    """FedAdam / FedYogi (Reddi et al., ICLR 2021, Alg. 2 — no bias
+    correction) applied the same outside-the-body way as
+    :func:`_apply_server_momentum`: the aggregate reconstructs as
+    ``(p' - p)/server_lr`` from the body's plain update, then the
+    adaptive step REPLACES it::
+
+        m' = b1*m + (1-b1)*agg
+        v' = b2*v + (1-b2)*agg^2                    (adam)
+        v' = v - (1-b2)*agg^2*sign(v - agg^2)       (yogi)
+        p  = p_old + server_lr * m' / (sqrt(v') + eps)
+
+    Returns ``(params_out, m', v')`` — all buffer math float32.
+    """
+    s = jnp.float32(cfg.server_lr)
+    b1 = jnp.float32(cfg.server_beta1)
+    b2 = jnp.float32(cfg.server_beta2)
+    eps = jnp.float32(cfg.server_eps)
+    agg = jax.tree.map(
+        lambda po, pn: (pn.astype(jnp.float32) - po.astype(jnp.float32)) / s,
+        old_params,
+        new_params,
+    )
+    new_m = jax.tree.map(lambda mm, g: b1 * mm + (1.0 - b1) * g, m, agg)
+    if cfg.server_opt == "yogi":
+        new_v = jax.tree.map(
+            lambda vv, g: vv - (1.0 - b2) * g * g * jnp.sign(vv - g * g), v, agg
+        )
+    else:
+        new_v = jax.tree.map(lambda vv, g: b2 * vv + (1.0 - b2) * g * g, v, agg)
+    out_p = jax.tree.map(
+        lambda po, mm, vv: (
+            po.astype(jnp.float32) + s * mm / (jnp.sqrt(vv) + eps)
+        ).astype(po.dtype),
+        old_params,
+        new_m,
+        new_v,
+    )
+    return out_p, new_m, new_v
+
+
 def build_round_fn(
     cfg: Config, mesh: Mesh, attack: str = "none", pair_seeds=None
 ) -> Callable:
@@ -560,8 +601,12 @@ def build_round_fn(
         metrics = {"train_loss": losses}
         if emit_delta:
             metrics["delta"] = out[3]
-        server_m = state.server_m
-        if cfg.server_momentum > 0.0:
+        server_m, server_v = state.server_m, state.server_v
+        if cfg.server_opt in ("adam", "yogi"):
+            new_params, server_m, server_v = _apply_server_opt(
+                cfg, state.params, new_params, server_m, server_v
+            )
+        elif cfg.server_momentum > 0.0:
             new_params, server_m = _apply_server_momentum(
                 cfg, state.params, new_params, server_m
             )
@@ -571,6 +616,7 @@ def build_round_fn(
             rng=state.rng,
             round_idx=state.round_idx + 1,
             server_m=server_m,
+            server_v=server_v,
             scaffold_c=scaffold_c,
             scaffold_ci=scaffold_ci,
             compress_err=compress_err,
@@ -647,10 +693,10 @@ def build_multi_round_fn(
         params_spec, opt_spec = _model_parallel_specs(cfg, "pp")
 
     def multi_body(
-        params, opt_state, server_m, rng, x, y, trainer_mat, byz_gate, round0, base_key
+        params, opt_state, server_m, server_v, rng, x, y, trainer_mat, byz_gate, round0, base_key
     ):
         def step(carry, inputs):
-            params, opt_state, server_m = carry
+            params, opt_state, server_m, server_v = carry
             trainer_idx, r = inputs
             # Absolute round index — identical mask/attack keys to the
             # sequential driver's fold_in(base, round_idx).
@@ -658,37 +704,46 @@ def build_multi_round_fn(
             new_p, new_opt, losses = body(
                 params, opt_state, rng, x, y, trainer_idx, byz_gate, round0 + r, mask_key
             )
-            if cfg.server_momentum > 0.0:
+            if cfg.server_opt in ("adam", "yogi"):
+                new_p, server_m, server_v = _apply_server_opt(
+                    cfg, params, new_p, server_m, server_v
+                )
+            elif cfg.server_momentum > 0.0:
                 # Same helper as the sequential round — the momentum buffer
                 # rides the scan carry (replicated P() values inside
                 # shard_map, so the math is identical).
                 new_p, server_m = _apply_server_momentum(cfg, params, new_p, server_m)
-            return (new_p, new_opt, server_m), losses
+            return (new_p, new_opt, server_m, server_v), losses
 
         rounds = trainer_mat.shape[0]
-        (params, opt_state, server_m), losses = lax.scan(
-            step, (params, opt_state, server_m), (trainer_mat, jnp.arange(rounds))
+        (params, opt_state, server_m, server_v), losses = lax.scan(
+            step,
+            (params, opt_state, server_m, server_v),
+            (trainer_mat, jnp.arange(rounds)),
         )
-        return params, opt_state, server_m, losses  # losses: [R, L]
+        return params, opt_state, server_m, server_v, losses  # losses: [R, L]
 
     x_spec = P(PEER_AXIS, None, SEQ_AXIS) if seq_axis is not None else sp
-    # Momentum off => server_m is None (zero pytree leaves): a per-leaf
-    # model-parallel spec TREE cannot prefix-broadcast over None, so the
-    # slot must degrade to a bare P() spec; momentum on mirrors the params
-    # placement leaf-for-leaf.
-    m_spec = params_spec if cfg.server_momentum > 0.0 else P()
+    # Buffer off => None (zero pytree leaves): a per-leaf model-parallel
+    # spec TREE cannot prefix-broadcast over None, so the slot must
+    # degrade to a bare P() spec; on, it mirrors the params placement
+    # leaf-for-leaf.
+    has_m = cfg.server_momentum > 0.0 or cfg.server_opt != "sgd"
+    m_spec = params_spec if has_m else P()
+    v_spec = params_spec if cfg.server_opt in ("adam", "yogi") else P()
     smapped = jax.shard_map(
         multi_body,
         mesh=mesh,
-        in_specs=(params_spec, opt_spec, m_spec, sp, x_spec, sp, sr, sr, sr, sr),
-        out_specs=(params_spec, opt_spec, m_spec, P(None, PEER_AXIS)),
+        in_specs=(params_spec, opt_spec, m_spec, v_spec, sp, x_spec, sp, sr, sr, sr, sr),
+        out_specs=(params_spec, opt_spec, m_spec, v_spec, P(None, PEER_AXIS)),
     )
 
     def multi_round_fn(state: PeerState, x, y, trainer_mat, byz_gate, base_key):
-        new_params, new_opt, server_m, losses = smapped(
+        new_params, new_opt, server_m, server_v, losses = smapped(
             state.params,
             state.opt_state,
             state.server_m,
+            state.server_v,
             state.rng,
             x,
             y,
@@ -703,6 +758,7 @@ def build_multi_round_fn(
             rng=state.rng,
             round_idx=state.round_idx + trainer_mat.shape[0],
             server_m=server_m,
+            server_v=server_v,
         )
         return new_state, {"train_loss": losses}
 

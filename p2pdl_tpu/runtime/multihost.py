@@ -237,6 +237,9 @@ def shard_peer_state(state, cfg: Config, topo: HostTopology, mesh):
         server_m=None
         if state.server_m is None
         else jax.tree.map(put_rep, state.server_m),
+        server_v=None
+        if state.server_v is None
+        else jax.tree.map(put_rep, state.server_v),
         scaffold_c=None
         if state.scaffold_c is None
         else jax.tree.map(put_rep, state.scaffold_c),

@@ -43,6 +43,8 @@ def _state_to_tree(state: PeerState) -> dict[str, Any]:
     # checkpoints stay loadable).
     if state.server_m is not None:
         tree["server_m"] = state.server_m
+    if state.server_v is not None:
+        tree["server_v"] = state.server_v
     if state.scaffold_c is not None:
         tree["scaffold_c"] = state.scaffold_c
         tree["scaffold_ci"] = state.scaffold_ci
@@ -58,6 +60,7 @@ def _tree_to_state(tree: dict[str, Any]) -> PeerState:
         rng=tree["rng"],
         round_idx=tree["round_idx"],
         server_m=tree.get("server_m"),
+        server_v=tree.get("server_v"),
         scaffold_c=tree.get("scaffold_c"),
         scaffold_ci=tree.get("scaffold_ci"),
         compress_err=tree.get("compress_err"),
