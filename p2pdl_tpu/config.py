@@ -323,6 +323,16 @@ class Config:
                     f"(the gated two-program round applies its server update "
                     f"in the second program)"
                 )
+            if self.param_dtype != "float32":
+                raise ValueError(
+                    f"{knob} requires param_dtype='float32': the server "
+                    f"buffers are fed by the pseudo-gradient reconstructed "
+                    f"as (p' - p)/server_lr from param-dtype arrays, and a "
+                    f"low-precision dtype quantizes it to ulp(p)/server_lr "
+                    f"— small aggregates round to zero and the adaptive v "
+                    f"accumulates quantization noise "
+                    f"(got param_dtype={self.param_dtype!r})"
+                )
         if self.weight_decay < 0:
             raise ValueError(f"weight_decay must be >= 0, got {self.weight_decay}")
         if self.gossip_graph not in ("ring", "exponential"):
@@ -602,6 +612,14 @@ class Config:
                 raise ValueError(
                     "scaffold requires plain SGD local steps (option II's "
                     "c_i update divides the net delta by K*lr)"
+                )
+            if self.weight_decay > 0.0 or self.fedprox_mu > 0.0:
+                raise ValueError(
+                    "scaffold requires weight_decay=0 and fedprox_mu=0: "
+                    "either folds a non-gradient term into the local delta, "
+                    "so c_i <- -delta/(K*lr) would absorb decay/prox "
+                    "components instead of the average gradient the "
+                    "correction assumes"
                 )
             if self.peer_chunk > 0:
                 raise ValueError(

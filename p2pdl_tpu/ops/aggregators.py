@@ -151,14 +151,40 @@ def _bulyan_select(d2: jnp.ndarray, f: int, theta: int) -> jnp.ndarray:
     return jax.lax.fori_loop(0, theta, step, jnp.zeros_like(d2[:, 0]))
 
 
+def closest_to_median_mean(srt: jnp.ndarray, beta: int) -> jnp.ndarray:
+    """Per-coordinate mean of the ``beta`` values CLOSEST TO THE MEDIAN of
+    a ``[theta, D]`` column-sorted selection (El Mhamdi et al. 2018,
+    Alg. 3's second stage — not the middle-slice trimmed-mean shortcut,
+    which differs on skewed coordinate distributions where the nearest
+    set sits off-center).
+
+    In sorted order the beta nearest values to any point form a
+    contiguous window, so the argmin over the ``theta - beta + 1``
+    candidate windows of the farther-endpoint distance IS the paper's
+    greedy closest-first selection; window sums come off one cumsum.
+    Shared by the gathered and blockwise Bulyan paths."""
+    theta = srt.shape[0]
+    med = 0.5 * (srt[(theta - 1) // 2] + srt[theta // 2])  # [D]
+    n_win = theta - beta + 1
+    cost = jnp.maximum(
+        jnp.abs(srt[:n_win] - med[None]),
+        jnp.abs(srt[beta - 1 :] - med[None]),
+    )
+    i = jnp.argmin(cost, axis=0)  # [D] chosen window start per coordinate
+    csum = jnp.cumsum(srt, axis=0)
+    csum = jnp.concatenate([jnp.zeros_like(csum[:1]), csum], axis=0)
+    wsum = csum[beta:] - csum[:-beta]  # [n_win, D]
+    return jnp.take_along_axis(wsum, i[None], axis=0)[0] / beta
+
+
 def bulyan(deltas: Any, f: int) -> Any:
     """Bulyan (El Mhamdi et al., ICML 2018): iterative-Krum-select
     ``theta = T - 2f`` updates, then aggregate them coordinate-wise by the
-    ``theta - 2f`` values nearest the median (the middle slice of the
-    sorted selection). Combines Krum's distance filtering with
-    coordinate-wise trimming, closing Krum's leeway for a selected-but-
-    poisoned update to move single coordinates by the full honest spread.
-    Requires ``T >= 4f + 3``."""
+    ``theta - 2f`` values closest to the per-coordinate median of the
+    selection (:func:`closest_to_median_mean`). Combines Krum's distance
+    filtering with coordinate-wise trimming, closing Krum's leeway for a
+    selected-but-poisoned update to move single coordinates by the full
+    honest spread. Requires ``T >= 4f + 3``."""
     leaves = jax.tree.leaves(deltas)
     t = leaves[0].shape[0]
     if t < 4 * f + 3:
@@ -173,7 +199,7 @@ def bulyan(deltas: Any, f: int) -> Any:
         # selected theta occupy the top rows in value order per coordinate.
         masked = jnp.where(sel[:, None] > 0, flat, jnp.inf)
         srt = jnp.sort(masked, axis=0)[:theta]  # [theta, D] selected, sorted
-        mid = jnp.mean(srt[f : f + beta], axis=0)  # middle beta of theta
+        mid = closest_to_median_mean(srt, beta)
         return mid.reshape(l.shape[1:]).astype(l.dtype)
 
     return jax.tree.unflatten(

@@ -45,10 +45,15 @@ def topk_ef(delta: Any, err: Any, ratio: float) -> tuple[Any, Any]:
 
     ``v = delta + err``; keep the ``ceil(ratio * D)`` largest-|v|
     coordinates of each peer's full flattened update; ``sent`` carries
-    them (zeros elsewhere, float32), ``new_err = v - sent``. Magnitude
-    ties at the threshold all ship (the mask is ``|v| >= kth``), so the
-    kept count can exceed k by the tie multiplicity — correctness-neutral
-    for EF (anything extra shipped just leaves the residual sooner).
+    them (zeros elsewhere, in each DELTA leaf's dtype — what actually
+    ships), ``new_err = v - sent_as_shipped``. The residual is computed
+    against the dtype-cast value, not the float32 selection: with a
+    low-precision delta dtype the cast's quantization error must stay in
+    the residual (and telescope out next round) rather than silently
+    escape the EF sum. Magnitude ties at the threshold all ship (the
+    mask is ``|v| >= kth``), so the kept count can exceed k by the tie
+    multiplicity — correctness-neutral for EF (anything extra shipped
+    just leaves the residual sooner).
     """
     leaves = jax.tree.leaves(delta)
     l_per_dev = leaves[0].shape[0]
@@ -61,5 +66,8 @@ def topk_ef(delta: Any, err: Any, ratio: float) -> tuple[Any, Any]:
         mag = jnp.abs(v)
         kth = jax.lax.top_k(mag, k)[0][:, -1]  # [L] per-peer threshold
         sent = jnp.where(mag >= kth[:, None], v, 0.0)
-    new_err = v - sent
-    return _unflat(sent, err), _unflat(new_err, err)
+    sent_tree = jax.tree.map(
+        lambda s, d: s.astype(d.dtype), _unflat(sent, err), delta
+    )
+    new_err = v - _flat(sent_tree, l_per_dev)
+    return sent_tree, _unflat(new_err, err)

@@ -168,18 +168,24 @@ class MoEFFN(nn.Module):
 # Leaf-path classification for expert-stacked params, anchored on the
 # OWNING MODULE's scope (``.../MoEFFN_k/wi``), not the bare leaf name — a
 # future module reusing wi/bi/wo/bo must not silently get its leading dim
-# expert-sharded. The root-scope alternative covers a bare MoEFFN used as
-# the top-level module (unit tests init it directly).
-_EXPERT_LEAF = re.compile(r"(^|/)MoEFFN_\d+/(wi|bi|wo|bo)$|^(wi|bi|wo|bo)$")
+# expert-sharded. Root-scope bare names match only under the explicit
+# ``root_is_moe`` opt-in below (a MoEFFN initialized directly as the
+# top-level module, as the unit tests do).
+_EXPERT_LEAF = re.compile(r"(^|/)MoEFFN_\d+/(wi|bi|wo|bo)$")
+_EXPERT_LEAF_ROOT = re.compile(r"(^|/)MoEFFN_\d+/(wi|bi|wo|bo)$|^(wi|bi|wo|bo)$")
 
 
-def param_specs(params, ep_axis: str = EP_AXIS):
+def param_specs(params, ep_axis: str = EP_AXIS, root_is_moe: bool = False):
     """Per-leaf ``PartitionSpec`` pytree: expert-stacked leaves split their
     leading (expert) dim over the ep axis; everything else replicated
-    (shared walk: ``ops.placement.leading_dim_specs``)."""
+    (shared walk: ``ops.placement.leading_dim_specs``). ``root_is_moe``
+    opts top-level bare ``wi/bi/wo/bo`` names into expert sharding — only
+    for a tree whose ROOT module is a MoEFFN; the default keeps any other
+    module's same-named params replicated instead of silently missharded."""
     from p2pdl_tpu.ops.placement import leading_dim_specs
 
-    return leading_dim_specs(params, _EXPERT_LEAF, ep_axis)
+    pattern = _EXPERT_LEAF_ROOT if root_is_moe else _EXPERT_LEAF
+    return leading_dim_specs(params, pattern, ep_axis)
 
 
 def validate_ep_geometry(num_experts: int, ep_shards: int, batch_size: int) -> None:

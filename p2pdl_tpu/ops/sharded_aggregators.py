@@ -270,9 +270,10 @@ def bulyan_sharded(
     """Bulyan with O(P × block) transient: the iterative Krum selection
     runs on the centered-Gram distance matrix (``[T, T]`` host of the same
     ``_bulyan_select`` loop as the gathered path), and the per-coordinate
-    middle-slice aggregation streams through the feature blocks like
+    closest-to-median aggregation (``closest_to_median_mean``, the paper's
+    Alg. 3 second stage) streams through the feature blocks like
     trimmed-mean — the selection mask rides into ``reduce_fn``."""
-    from p2pdl_tpu.ops.aggregators import _bulyan_select
+    from p2pdl_tpu.ops.aggregators import _bulyan_select, closest_to_median_mean
 
     t = trainer_idx.shape[0]
     if t < 4 * f + 3:
@@ -285,7 +286,7 @@ def bulyan_sharded(
     def reduce_fn(g):  # [T, B] this feature block's trainer values
         masked = jnp.where(sel[:, None] > 0, g.astype(jnp.float32), jnp.inf)
         srt = jnp.sort(masked, axis=0)[:theta]
-        return jnp.mean(srt[f : f + beta], axis=0)
+        return closest_to_median_mean(srt, beta)
 
     return _coordinate_reduce_sharded(delta, trainer_idx, reduce_fn, axis_name, block)
 

@@ -1466,6 +1466,9 @@ def _general_sync_body(
             delta, new_opt, losses = train(
                 params, opt_state, rng, x, y, byz_gate, round_idx, mask_key
             )
+            # topk_ef ships each leaf in the delta dtype and computes the
+            # residual against the cast value, so the quantization error of
+            # a low-precision param_dtype stays inside the EF telescoping.
             sent, new_err = topk_ef(delta, err, cfg.compress_ratio)
 
             def keep_trainers(n, o):
@@ -1473,9 +1476,6 @@ def _general_sync_body(
                 return jnp.where(m, n, o)
 
             new_err = jax.tree.map(keep_trainers, new_err, err)
-            sent = jax.tree.map(
-                lambda s, d: s.astype(d.dtype), sent, delta
-            )
             new_p, kept_opt = agg(
                 params, opt_state, new_opt, sent, trainer_idx, mask_key, round_idx
             )

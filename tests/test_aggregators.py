@@ -177,6 +177,27 @@ def test_centered_clip_defends_against_ipm():
     assert cos > 0.95, cos
 
 
+def test_bulyan_closest_to_median_matches_greedy():
+    """The vectorized window argmin in ``closest_to_median_mean`` equals
+    the paper's greedy per-coordinate selection (repeatedly take the
+    remaining value nearest the median) on random AND skewed columns —
+    including columns where the nearest-beta set sits off-center, the
+    case a middle-slice trimmed mean gets wrong."""
+    rng = np.random.default_rng(7)
+    theta, beta, d = 9, 5, 32
+    cols = rng.normal(size=(theta, d)).astype(np.float32)
+    cols[:, :8] = np.abs(cols[:, :8]) ** 3  # heavy right skew
+    cols[:3, 8:12] -= 10.0  # far-left cluster: window must shift right
+    srt = np.sort(cols, axis=0)
+    got = np.asarray(agg.closest_to_median_mean(jnp.asarray(srt), beta))
+    for j in range(d):
+        col = srt[:, j]
+        med = 0.5 * (col[(theta - 1) // 2] + col[theta // 2])
+        picked = sorted(range(theta), key=lambda i: abs(col[i] - med))[:beta]
+        want = col[picked].mean()
+        np.testing.assert_allclose(got[j], want, rtol=1e-5, err_msg=f"col {j}")
+
+
 def test_bulyan_can_select_peer_zero():
     """Regression: the selection-loop carry must not poison index 0 (an
     inf*0=NaN in the init once knocked peer 0 out of every selection).

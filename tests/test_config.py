@@ -35,6 +35,16 @@ def test_json_roundtrip():
         {"trimmed_mean_beta": 0.5},
         {"samples_per_peer": 8, "batch_size": 32},
         {"byzantine_f": -1},
+        # Stateful server optimizers reconstruct the pseudo-gradient as
+        # (p'-p)/server_lr from param-dtype arrays: a low-precision dtype
+        # quantizes it to ulp(p)/server_lr and corrupts the buffers.
+        {"server_momentum": 0.9, "param_dtype": "bfloat16"},
+        {"server_opt": "adam", "param_dtype": "bfloat16"},
+        {"server_opt": "yogi", "param_dtype": "bfloat16"},
+        # SCAFFOLD's c_i <- -delta/(K*lr) assumes delta is pure-gradient
+        # mass; decay/prox fold non-gradient terms into it.
+        {"scaffold": True, "weight_decay": 1e-4},
+        {"scaffold": True, "fedprox_mu": 0.1},
     ],
 )
 def test_validation_rejects(kwargs):

@@ -51,6 +51,22 @@ def test_top1_route_dispatch_and_capacity():
     )
 
 
+def test_param_specs_root_names_need_explicit_opt_in():
+    """A NON-MoE module's top-level params named wi/bi/wo/bo stay
+    replicated by default — only ``root_is_moe=True`` (a MoEFFN initialized
+    as the root module) opts bare root names into expert sharding, and a
+    scoped ``MoEFFN_k/wi`` shards either way."""
+    tree = {
+        "wi": jnp.zeros((4, 8)),  # same name, different module: replicate
+        "MoEFFN_0": {"wi": jnp.zeros((4, 8))},  # scoped: expert-shard
+    }
+    specs = moe.param_specs(tree, "ep")
+    assert specs["wi"] == P(), specs["wi"]
+    assert specs["MoEFFN_0"]["wi"] == P("ep", None), specs["MoEFFN_0"]["wi"]
+    opted = moe.param_specs(tree, "ep", root_is_moe=True)
+    assert opted["wi"] == P("ep", None)
+
+
 def test_moe_ffn_ep_matches_dense():
     """Library level: the ep-sharded MoE FFN (4-way expert split) equals its
     dense twin on the SAME param tree — forward and all parameter grads —
@@ -68,7 +84,7 @@ def test_moe_ffn_ep_matches_dense():
         jax.shard_map(
             lambda p, xx: epm.apply({"params": p}, xx),
             mesh=mesh,
-            in_specs=(moe.param_specs(params, "ep"), P("ep")),
+            in_specs=(moe.param_specs(params, "ep", root_is_moe=True), P("ep")),
             out_specs=P("ep"),
         )
     )
