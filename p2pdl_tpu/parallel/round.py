@@ -646,20 +646,13 @@ def build_multi_round_fn(
     equal R sequential rounds exactly (test-asserted).
 
     The trust plane needs the host between training and aggregation, so
-    fusion requires ``brb_enabled=False``.
+    fusion requires ``brb_enabled=False``. SCAFFOLD control variates and
+    the EF compression residual ride the same scan carry as the server
+    momentum/FedOpt buffers (their bodies already emit the updated state
+    per round; the fused==sequential equivalence tests cover both).
     """
     if cfg.brb_enabled:
         raise ValueError("fused rounds cannot host the BRB trust plane between phases")
-    if cfg.scaffold:
-        raise ValueError(
-            "fused rounds with SCAFFOLD are not yet supported (the control-"
-            "variate state would need to thread the fused scan carry)"
-        )
-    if cfg.compress != "none":
-        raise ValueError(
-            "fused rounds with compression are not yet supported (the "
-            "error-feedback residual would need to thread the fused scan carry)"
-        )
     pair_seeds = _resolve_pair_seeds(cfg, pair_seeds)
     seq_axis, tp_axis, ep_axis, pp_axis = _mesh_axes_for(cfg, mesh)
     model = build_model(
@@ -693,17 +686,21 @@ def build_multi_round_fn(
         params_spec, opt_spec = _model_parallel_specs(cfg, "pp")
 
     def multi_body(
-        params, opt_state, server_m, server_v, rng, x, y, trainer_mat, byz_gate, round0, base_key
+        params, opt_state, server_m, server_v, extras, rng, x, y, trainer_mat, byz_gate, round0, base_key
     ):
         def step(carry, inputs):
-            params, opt_state, server_m, server_v = carry
+            params, opt_state, server_m, server_v, extras = carry
             trainer_idx, r = inputs
             # Absolute round index — identical mask/attack keys to the
             # sequential driver's fold_in(base, round_idx).
             mask_key = jax.random.fold_in(base_key, round0 + r)
-            new_p, new_opt, losses = body(
-                params, opt_state, rng, x, y, trainer_idx, byz_gate, round0 + r, mask_key
+            outs = body(
+                params, opt_state, *extras, rng, x, y, trainer_idx, byz_gate, round0 + r, mask_key
             )
+            new_p, new_opt, losses = outs[:3]
+            # SCAFFOLD: (c, ci); compression: (err,) — the bodies emit the
+            # updated state after the losses, in the same order they take it.
+            extras = tuple(outs[3:])
             if cfg.server_opt in ("adam", "yogi"):
                 new_p, server_m, server_v = _apply_server_opt(
                     cfg, params, new_p, server_m, server_v
@@ -713,15 +710,15 @@ def build_multi_round_fn(
                 # rides the scan carry (replicated P() values inside
                 # shard_map, so the math is identical).
                 new_p, server_m = _apply_server_momentum(cfg, params, new_p, server_m)
-            return (new_p, new_opt, server_m, server_v), losses
+            return (new_p, new_opt, server_m, server_v, extras), losses
 
         rounds = trainer_mat.shape[0]
-        (params, opt_state, server_m, server_v), losses = lax.scan(
+        (params, opt_state, server_m, server_v, extras), losses = lax.scan(
             step,
-            (params, opt_state, server_m, server_v),
+            (params, opt_state, server_m, server_v, extras),
             (trainer_mat, jnp.arange(rounds)),
         )
-        return params, opt_state, server_m, server_v, losses  # losses: [R, L]
+        return params, opt_state, server_m, server_v, extras, losses  # losses: [R, L]
 
     x_spec = P(PEER_AXIS, None, SEQ_AXIS) if seq_axis is not None else sp
     # Buffer off => None (zero pytree leaves): a per-leaf model-parallel
@@ -731,19 +728,35 @@ def build_multi_round_fn(
     has_m = cfg.server_momentum > 0.0 or cfg.server_opt != "sgd"
     m_spec = params_spec if has_m else P()
     v_spec = params_spec if cfg.server_opt in ("adam", "yogi") else P()
+    # Extra per-round state rides the scan carry next to the server buffers.
+    # ONE list of (PeerState field, spec) pairs drives the spec, the packing,
+    # and the state rebuild below — the bodies emit these fields after the
+    # losses in this same order. Config restricts both families to the
+    # data-parallel sync layout, so the server's c is replicated and the
+    # per-peer stacks (c_i, err) shard over the peer axis like the
+    # optimizer state.
+    if cfg.scaffold:
+        extra_fields = (("scaffold_c", P()), ("scaffold_ci", sp))
+    elif cfg.compress != "none":
+        extra_fields = (("compress_err", sp),)
+    else:
+        extra_fields = ()
+    extras_spec = tuple(s for _, s in extra_fields)
     smapped = jax.shard_map(
         multi_body,
         mesh=mesh,
-        in_specs=(params_spec, opt_spec, m_spec, v_spec, sp, x_spec, sp, sr, sr, sr, sr),
-        out_specs=(params_spec, opt_spec, m_spec, v_spec, P(None, PEER_AXIS)),
+        in_specs=(params_spec, opt_spec, m_spec, v_spec, extras_spec, sp, x_spec, sp, sr, sr, sr, sr),
+        out_specs=(params_spec, opt_spec, m_spec, v_spec, extras_spec, P(None, PEER_AXIS)),
     )
 
     def multi_round_fn(state: PeerState, x, y, trainer_mat, byz_gate, base_key):
-        new_params, new_opt, server_m, server_v, losses = smapped(
+        extras = tuple(getattr(state, f) for f, _ in extra_fields)
+        new_params, new_opt, server_m, server_v, extras, losses = smapped(
             state.params,
             state.opt_state,
             state.server_m,
             state.server_v,
+            extras,
             state.rng,
             x,
             y,
@@ -752,6 +765,7 @@ def build_multi_round_fn(
             state.round_idx,
             base_key,
         )
+        carried = {f: v for (f, _), v in zip(extra_fields, extras)}
         new_state = PeerState(
             params=new_params,
             opt_state=new_opt,
@@ -759,6 +773,9 @@ def build_multi_round_fn(
             round_idx=state.round_idx + trainer_mat.shape[0],
             server_m=server_m,
             server_v=server_v,
+            scaffold_c=carried.get("scaffold_c", state.scaffold_c),
+            scaffold_ci=carried.get("scaffold_ci", state.scaffold_ci),
+            compress_err=carried.get("compress_err", state.compress_err),
         )
         return new_state, {"train_loss": losses}
 

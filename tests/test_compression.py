@@ -129,8 +129,53 @@ def test_validation_and_gates(mesh8):
         )
     with pytest.raises(ValueError, match="dp_clip"):
         Config(**CFG, compress="topk", dp_clip=1.0)
-    with pytest.raises(ValueError, match="compression"):
-        build_multi_round_fn(Config(**CFG, compress="topk"), mesh8)
+
+
+def test_fused_equals_sequential(mesh8):
+    """R fused EF rounds == R sequential rounds: params AND the per-peer
+    residual — the error-feedback state rides the on-device scan carry
+    with the identical per-round key schedule."""
+    cfg = Config(**{**CFG, "trainers_per_round": 4}, compress="topk", compress_ratio=0.2)
+    rounds = 3
+    base_key = jax.random.PRNGKey(cfg.seed)
+    trainer_mat = np.stack(
+        [
+            np.sort(np.random.default_rng(r).choice(8, 4, replace=False))
+            for r in range(rounds)
+        ]
+    )
+    byz = jnp.zeros(8)
+    data = make_federated_data(cfg, eval_samples=16)
+    sh = peer_sharding(mesh8)
+    x = jax.device_put(data.x, sh)
+    y = jax.device_put(data.y, sh)
+
+    seq_state = shard_state(init_peer_state(cfg), cfg, mesh8)
+    fn = build_round_fn(cfg, mesh8)
+    seq_losses = []
+    for r in range(rounds):
+        seq_state, m = fn(
+            seq_state, x, y, jnp.asarray(trainer_mat[r], jnp.int32), byz,
+            jax.random.fold_in(base_key, r),
+        )
+        seq_losses.append(np.asarray(m["train_loss"]))
+
+    fused_state = shard_state(init_peer_state(cfg), cfg, mesh8)
+    multi_fn = build_multi_round_fn(cfg, mesh8)
+    fused_state, fm = multi_fn(
+        fused_state, x, y, jnp.asarray(trainer_mat, jnp.int32), byz, base_key
+    )
+    np.testing.assert_allclose(
+        np.asarray(fm["train_loss"]), np.stack(seq_losses), atol=1e-6
+    )
+    for field in ("params", "compress_err"):
+        for a, b in zip(
+            jax.tree.leaves(getattr(fused_state, field)),
+            jax.tree.leaves(getattr(seq_state, field)),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, err_msg=field
+            )
 
 
 def test_compression_composes_with_robust_aggregation(mesh8):

@@ -139,13 +139,53 @@ def test_checkpoint_roundtrip(tmp_path, mesh8):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_validation_and_fused_gate(mesh8):
+def test_validation(mesh8):
     with pytest.raises(ValueError, match="fedavg"):
         Config(**CFG, scaffold=True, aggregator="median")
     with pytest.raises(ValueError, match="SGD"):
         Config(**CFG, scaffold=True, momentum=0.9)
-    with pytest.raises(ValueError, match="SCAFFOLD"):
-        build_multi_round_fn(Config(**CFG, scaffold=True), mesh8)
+
+
+def test_fused_equals_sequential(mesh8):
+    """R fused SCAFFOLD rounds == R sequential rounds: params AND the
+    control-variate state (c, c_i) — the carry threads both through the
+    on-device scan with the identical per-round key schedule."""
+    cfg = Config(**CFG, scaffold=True)
+    rounds = 3
+    base_key = jax.random.PRNGKey(cfg.seed)
+    trainer_mat = np.stack(
+        [
+            np.sort(np.random.default_rng(r).choice(8, 4, replace=False))
+            for r in range(rounds)
+        ]
+    )
+    byz = jnp.zeros(8)
+
+    _, seq_state, x, y, fn = _setup(cfg, mesh8)
+    seq_losses = []
+    for r in range(rounds):
+        seq_state, m = fn(
+            seq_state, x, y, jnp.asarray(trainer_mat[r], jnp.int32), byz,
+            jax.random.fold_in(base_key, r),
+        )
+        seq_losses.append(np.asarray(m["train_loss"]))
+
+    fused_state = shard_state(init_peer_state(cfg), cfg, mesh8)
+    multi_fn = build_multi_round_fn(cfg, mesh8)
+    fused_state, fm = multi_fn(
+        fused_state, x, y, jnp.asarray(trainer_mat, jnp.int32), byz, base_key
+    )
+    np.testing.assert_allclose(
+        np.asarray(fm["train_loss"]), np.stack(seq_losses), atol=1e-6
+    )
+    for field in ("params", "scaffold_c", "scaffold_ci"):
+        for a, b in zip(
+            jax.tree.leaves(getattr(fused_state, field)),
+            jax.tree.leaves(getattr(seq_state, field)),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, err_msg=field
+            )
 
 
 def test_scaffold_rejects_dp():
