@@ -172,11 +172,15 @@ class Config:
     # Key freshness: "never" = one keyring per experiment (a dropped peer's
     # reconstructed scalar discloses its masks for rounds up to the drop;
     # the driver rotates it afterwards). "round" = fresh ECDH keys + Shamir
-    # shares for EVERY peer EVERY round — the full Bonawitz per-execution
-    # semantics: reconstruction discloses exactly one round, ever. Costs
-    # O(P^2/2) host ECDH + O(P^2 t) share field ops per round, so it is
-    # validated to the BRB-gated path (runtime seed matrix; the fused paths
-    # bake seeds as compile-time constants) and to <= 256 peers.
+    # shares every round — the full Bonawitz per-execution semantics:
+    # reconstruction discloses exactly one round, ever. Validated to the
+    # BRB-gated path (runtime seed matrix; the fused paths bake seeds as
+    # compile-time constants). Under the full mask graph
+    # (secure_agg_neighbors=0) it costs O(P^2/2) host ECDH + O(P^2 t)
+    # share field ops per round and is capped at 256 peers; under the Bell
+    # k-ring only the round's ring pairs mask, so the driver rotates just
+    # the sampled trainers — O(T*k) ECDH + committee-held shares
+    # (protocol/secure_keys.ring_committees) — valid at 1024+ peers.
     secure_agg_rekey: str = "never"
     # Stream the vmapped peer stack through chunks of this size, fusing the
     # masked-sum aggregation into the scan: peak transient HBM becomes
@@ -189,6 +193,13 @@ class Config:
     # compiled round function itself is trust-agnostic).
     brb_enabled: bool = False
     round_timeout_s: float = 30.0
+    # BRB quorum scope: 0 = every peer votes (Bracha over all P; O(P^2)
+    # control messages per broadcast — fine to a few hundred peers); m > 0
+    # = a deterministic m-member committee votes (O(m^2) per broadcast,
+    # the standard committee-BRB scaling move — how the trust plane runs
+    # at 1024+ peers). Tolerance becomes f Byzantine COMMITTEE members
+    # (m > 3f still required). Sampled once per experiment from `seed`.
+    brb_committee: int = 0
 
     # Execution.
     seed: int = 42
@@ -258,6 +269,24 @@ class Config:
             )
         if self.byzantine_f < 0:
             raise ValueError(f"byzantine_f must be >= 0, got {self.byzantine_f}")
+        if self.brb_committee < 0:
+            raise ValueError(f"brb_committee must be >= 0, got {self.brb_committee}")
+        if self.brb_committee > 0:
+            if not self.brb_enabled:
+                raise ValueError(
+                    "brb_committee is only meaningful with brb_enabled=True"
+                )
+            if self.brb_committee > self.num_peers:
+                raise ValueError(
+                    f"brb_committee ({self.brb_committee}) cannot exceed "
+                    f"num_peers ({self.num_peers})"
+                )
+            if self.brb_committee <= 3 * self.byzantine_f:
+                raise ValueError(
+                    f"brb_committee must exceed 3*byzantine_f (Bracha n > 3f "
+                    f"within the committee); got {self.brb_committee} with "
+                    f"f={self.byzantine_f}"
+                )
         if self.aggregator not in AGGREGATORS:
             raise ValueError(f"unknown aggregator {self.aggregator!r}; one of {AGGREGATORS}")
         if self.model not in MODELS:
@@ -549,10 +578,13 @@ class Config:
                     "gated pipeline takes the seed matrix at runtime; fused paths "
                     "bake it as a compile-time constant)"
                 )
-            if self.num_peers > 256:
+            if self.num_peers > 256 and self.secure_agg_neighbors == 0:
                 raise ValueError(
-                    "secure_agg_rekey='round' re-derives O(P^2) pair seeds per "
-                    f"round on the host; capped at 256 peers, got {self.num_peers}"
+                    "secure_agg_rekey='round' with the full Bonawitz mask graph "
+                    "re-derives O(P^2) pair seeds per round on the host; capped "
+                    f"at 256 peers, got {self.num_peers} — set "
+                    "secure_agg_neighbors=k (Bell k-ring) for per-round "
+                    "freshness at this scale (O(T*k) ECDH per round)"
                 )
         if self.robust_impl not in ("blockwise", "gathered"):
             raise ValueError(

@@ -40,9 +40,15 @@ under the gated pipeline), so a peer that later re-joins masks under a
 fresh scalar the old shares say nothing about. For the full
 per-execution semantics — reconstruction can ever disclose exactly ONE
 round — set ``cfg.secure_agg_rekey="round"``: the driver re-keys every
-peer every round (fresh scalars + fresh shares; O(P^2/2) host ECDH per
-round, so config-capped at 256 peers and restricted to the BRB-gated
-path, whose seed matrix is a runtime argument).
+round (fresh scalars + fresh shares), restricted to the BRB-gated path,
+whose seed matrix is a runtime argument. Under the full Bonawitz mask
+graph that costs O(P^2/2) host ECDH per round (config-capped at 256
+peers); under the Bell k-ring (``secure_agg_neighbors=k``) only the
+round's ring pairs ever mask, so the driver rotates just the round's
+trainers and derives O(T*k) pair seeds (:meth:`seed_matrix_ring`), with
+Shamir shares held by each peer's 2k-neighbor COMMITTEE on the static id
+ring (:func:`ring_committees`) instead of the whole peer set — per-round
+freshness at 1024+ peers.
 """
 
 from __future__ import annotations
@@ -57,6 +63,56 @@ from cryptography.hazmat.primitives.kdf.hkdf import HKDF
 from p2pdl_tpu.protocol import shamir
 
 _INFO = b"p2pdl-tpu secure-agg v1"
+
+
+def ring_committees(num_peers: int, k: int) -> list[list[int]]:
+    """Per-peer Shamir-share holder committees on the STATIC peer-id ring:
+    peer ``i``'s committee is its 2k ring neighbors ``(i +- d) mod P``,
+    ``d = 1..k`` (Bell et al. CCS 2020's neighbor-held shares — the same
+    trust radius the k-ring mask graph already assumes). The id ring is
+    deliberately NOT the per-round mask ring (rank among sampled
+    trainers): committees must be stable across rounds so holders keep
+    shares for peers that were not sampled with them."""
+    out = []
+    for i in range(num_peers):
+        seen = []
+        for d in range(1, k + 1):
+            for j in ((i + d) % num_peers, (i - d) % num_peers):
+                if j != i and j not in seen:
+                    seen.append(j)
+        out.append(seen)
+    return out
+
+
+def ring_pairs(trainer_ids, neighbors: int) -> set[tuple[int, int]]:
+    """The set of (lo, hi) global-id pairs the round's mask graph uses —
+    the HOST mirror of ``ops/secure_agg._partner_ids`` (ring by RANK among
+    the live entries of the pre-gate trainer vector, positional order,
+    wraparound when ``n_live <= neighbors``). The per-round rekey derives
+    ECDH seeds for exactly these pairs; the two MUST agree or a used pair
+    would mask under an unfilled (zero) seed — cancellation would still
+    hold (the matrix stays symmetric) but the mask would be derivable
+    from public state, silently voiding the privacy property."""
+    ids = [int(t) for t in trainer_ids]
+    live = [t for t in ids if t >= 0]  # positional order, like _partner_ids
+    n = len(live)
+    pairs: set[tuple[int, int]] = set()
+    if n <= 1:
+        return pairs
+    if not (neighbors and neighbors < len(ids) - 1):
+        for a in range(n):
+            for b in range(a + 1, n):
+                i, j = live[a], live[b]
+                if i != j:
+                    pairs.add((min(i, j), max(i, j)))
+        return pairs
+    half = neighbors // 2
+    for rank, i in enumerate(live):
+        for d in range(1, half + 1):
+            for j in (live[(rank + d) % n], live[(rank - d) % n]):
+                if j != i:
+                    pairs.add((min(i, j), max(i, j)))
+    return pairs
 
 
 def _derive_scalar(seed: int, peer_id: int, generation: int = 0) -> int:
@@ -95,6 +151,9 @@ class SecureAggKeyring:
         # the KeyServer. Everything an outside observer sees.
         self.public_keys = [k.public_key() for k in self._privs]
         self._shares: list[list[tuple[int, int]]] | None = None
+        # committees[i] = ordered holder ids for peer i's shares (None =
+        # every peer holds a share, the full-Bonawitz default).
+        self._committees: list[list[int]] | None = None
 
     # -- pairwise seeds -------------------------------------------------
     @staticmethod
@@ -132,6 +191,19 @@ class SecureAggKeyring:
                 mat[i, j] = mat[j, i] = (hi, lo)
         return mat
 
+    def seed_matrix_ring(self, trainer_ids, neighbors: int) -> np.ndarray:
+        """``[P, P, 2]`` uint32 seed matrix filled ONLY at the pairs this
+        round's k-ring mask graph uses (:func:`ring_pairs` over the
+        pre-gate trainer vector) — O(T x k) ECDH instead of O(P^2/2), the
+        per-round rekey cost that makes ``secure_agg_rekey="round"``
+        feasible at 1024+ peers. Unused entries stay zero; they are never
+        read by the round (the pairing mirror guarantees it)."""
+        mat = np.zeros((self.num_peers, self.num_peers, 2), np.uint32)
+        for i, j in ring_pairs(trainer_ids, neighbors):
+            hi, lo = self.pair_seed(i, j)
+            mat[i, j] = mat[j, i] = (hi, lo)
+        return mat
+
     def rotate(
         self,
         peer_id: int,
@@ -166,12 +238,7 @@ class SecureAggKeyring:
         self._privs[peer_id] = priv
         self.public_keys[peer_id] = priv.public_key()
         if self._shares is not None:
-            self._shares[peer_id] = shamir.split_secret(
-                priv.private_numbers().private_value,
-                self.num_peers,
-                self.share_threshold,
-                rng=rng,
-            )
+            self._shares[peer_id] = self._split_for(peer_id, rng=rng)
         if mat is not None:
             for j in range(self.num_peers):
                 if j == peer_id:
@@ -179,25 +246,49 @@ class SecureAggKeyring:
                 mat[peer_id, j] = mat[j, peer_id] = self.pair_seed(peer_id, j)
 
     # -- dropout recovery ----------------------------------------------
-    def distribute_shares(self, rng=None) -> None:
-        """Shamir-share every peer's private scalar among the peer set.
-        Share ``x = h + 1`` is held by peer ``h`` (in deployment each share
-        would travel to its holder over the authenticated transport)."""
-        self._shares = [
-            shamir.split_secret(
-                k.private_numbers().private_value,
-                self.num_peers,
-                self.share_threshold,
-                rng=rng,
-            )
-            for k in self._privs
-        ]
+    def _split_for(self, owner: int, rng=None) -> list[tuple[int, int]]:
+        secret = self._privs[owner].private_numbers().private_value
+        if self._committees is None:
+            return shamir.split_secret(secret, self.num_peers, self.share_threshold, rng=rng)
+        committee = self._committees[owner]
+        return shamir.split_secret(
+            secret, len(committee), self.threshold_for(owner), rng=rng
+        )
+
+    def threshold_for(self, owner: int) -> int:
+        """Shares needed to reconstruct ``owner``'s scalar: the global
+        honest-majority threshold, or a committee majority when shares are
+        committee-held (k+1 of the 2k ring neighbors at committee size 2k
+        — no k-coalition can unmask, the same radius the k-ring mask graph
+        already trusts)."""
+        if self._committees is None:
+            return self.share_threshold
+        return len(self._committees[owner]) // 2 + 1
+
+    def distribute_shares(self, rng=None, committees: list[list[int]] | None = None) -> None:
+        """Shamir-share every peer's private scalar — among the full peer
+        set by default (share ``x = h + 1`` held by peer ``h``), or among
+        per-peer ``committees`` (:func:`ring_committees`; share ``x = c + 1``
+        held by the committee's c-th member). Committee sharing is what
+        keeps per-round rekeying O(P x k^2) field ops instead of O(P^2 x t)
+        at scale. In deployment each share travels to its holder over the
+        authenticated transport."""
+        self._committees = committees
+        self._shares = [self._split_for(o, rng=rng) for o in range(self.num_peers)]
 
     def share_of(self, owner: int, holder: int) -> tuple[int, int]:
         """The share of ``owner``'s scalar held by peer ``holder``."""
         if self._shares is None:
             raise RuntimeError("distribute_shares() has not run")
-        return self._shares[owner][holder]
+        if self._committees is None:
+            return self._shares[owner][holder]
+        committee = self._committees[owner]
+        if holder not in committee:
+            raise ValueError(
+                f"peer {holder} holds no share of {owner} "
+                f"(committee: {committee})"
+            )
+        return self._shares[owner][committee.index(holder)]
 
     def reconstruct_seeds_for_dropped(
         self, dropped: int, holder_ids: list[int]
@@ -209,12 +300,15 @@ class SecureAggKeyring:
         ``share_threshold`` holders respond."""
         if self._shares is None:
             raise RuntimeError("distribute_shares() has not run")
-        if len(set(holder_ids)) < self.share_threshold:
+        holders = set(holder_ids)
+        if self._committees is not None:
+            holders &= set(self._committees[dropped])
+        need = self.threshold_for(dropped)
+        if len(holders) < need:
             raise ValueError(
-                f"dropout recovery needs {self.share_threshold} shares, "
-                f"got {len(set(holder_ids))}"
+                f"dropout recovery needs {need} shares, got {len(holders)}"
             )
-        shares = [self.share_of(dropped, h) for h in set(holder_ids)]
+        shares = [self.share_of(dropped, h) for h in holders]
         scalar = shamir.reconstruct_secret(shares)
         priv = ec.derive_private_key(scalar, ec.SECP256R1())
         row = np.zeros((self.num_peers, 2), np.uint32)

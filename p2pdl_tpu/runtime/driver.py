@@ -86,6 +86,16 @@ class _TrustPlane:
     ``lie_digests``: fault-injection hook — trainer id -> digest it falsely
     (but consistently) commits to, modeling a trainer whose broadcast
     delivers fine but does not match the update it actually submitted.
+
+    ``cfg.brb_committee = m > 0`` scopes the Bracha quorum to a
+    deterministic m-member committee instead of all P peers: trainers
+    (committee or not) SEND into the committee, whose members echo/ready
+    among themselves — O(m^2) control messages per broadcast instead of
+    O(P^2), which is what makes the trust plane feasible at 1024+ peers
+    (the standard committee-BRB scaling move; tolerance becomes f
+    Byzantine COMMITTEE members). The committee is sampled once per
+    experiment from ``cfg.seed``; per-round rotation is a deployment
+    concern outside the simulation's scope.
     """
 
     def __init__(self, cfg: Config, byz_ids: tuple[int, ...] = ()) -> None:
@@ -95,14 +105,26 @@ class _TrustPlane:
         self.byz_ids = set(byz_ids)
         self.lie_digests: dict[int, bytes] = {}
         self.broadcasters: list[Broadcaster] = []
-        brb_cfg = BRBConfig(cfg.num_peers, cfg.byzantine_f)
+        if cfg.brb_committee and cfg.brb_committee < cfg.num_peers:
+            rng = np.random.default_rng(cfg.seed)
+            self.committee = sorted(
+                int(p)
+                for p in rng.choice(cfg.num_peers, cfg.brb_committee, replace=False)
+            )
+        else:
+            self.committee = list(range(cfg.num_peers))
+        brb_cfg = BRBConfig(len(self.committee), cfg.byzantine_f)
         self._keys = []
+        # Every peer gets a keypair + broadcaster (any peer can be sampled
+        # as a trainer and must be able to originate a SEND); only
+        # committee members vote — their handlers alone are registered, so
+        # a non-member never echoes and cannot count toward any quorum.
         for pid in range(cfg.num_peers):
             priv, pub = generate_key_pair()
             self.key_server.register_key(pid, pub)
             self._keys.append(priv)
             self.broadcasters.append(Broadcaster(brb_cfg, pid, self.key_server, priv))
-        for pid in range(cfg.num_peers):
+        for pid in self.committee:
             self.hub.register(pid, self._make_handler(pid))
 
     def _make_handler(self, pid: int):
@@ -116,10 +138,11 @@ class _TrustPlane:
         return handler
 
     def _fan_out(self, src: int, msg) -> None:
-        # Fan out to every peer INCLUDING self: in Bracha each peer (the
-        # originator too) echoes, readies, and counts its own votes.
+        # Fan out to every COMMITTEE member INCLUDING self (when src is
+        # one): in Bracha each voting peer echoes, readies, and counts its
+        # own votes. With the full committee this is every peer.
         wire = brb_to_wire(msg)
-        for dst in range(self.cfg.num_peers):
+        for dst in self.committee:
             self.hub.send(src, dst, wire)
 
     def _payload(self, round_idx: int, tid: int, digest: bytes) -> bytes:
@@ -152,9 +175,9 @@ class _TrustPlane:
                 send_a, send_b = self.broadcasters[tid].broadcast_equivocating(
                     round_idx, payload, forged
                 )
-                half = self.cfg.num_peers // 2
-                for dst in range(self.cfg.num_peers):
-                    wire = brb_to_wire(send_a if dst < half else send_b)
+                half = len(self.committee) // 2
+                for rank, dst in enumerate(self.committee):
+                    wire = brb_to_wire(send_a if rank < half else send_b)
                     self.hub.send(tid, dst, wire)
             else:
                 for msg in self.broadcasters[tid].broadcast(round_idx, payload):
@@ -166,28 +189,28 @@ class _TrustPlane:
         delivered_at = {
             tid: [
                 pid
-                for pid in range(self.cfg.num_peers)
+                for pid in self.committee
                 if self.broadcasters[pid].delivered(tid, round_idx) is not None
             ]
             for tid in trainer_ids
         }
         # Sender vs receiver failure: a broadcast nobody delivered is the
         # SENDER's failure (dead or equivocating trainer) — it must not mark
-        # every receiver suspect. A peer is failed iff it missed a broadcast
-        # its peers did deliver (Bracha totality: once one honest peer
-        # delivers, all honest peers do — the hub pumps to quiescence, so
-        # non-delivery at quiescence is a real receiver fault).
+        # every receiver suspect. A voting peer is failed iff it missed a
+        # broadcast its peers did deliver (Bracha totality: once one honest
+        # peer delivers, all honest peers do — the hub pumps to quiescence,
+        # so non-delivery at quiescence is a real receiver fault).
         sender_failed = {t for t in honest_trainers if not delivered_at[t]}
         failed = [
             pid
-            for pid in range(self.cfg.num_peers)
+            for pid in self.committee
             if any(
                 pid not in delivered_at[tid]
                 for tid in honest_trainers
                 if tid not in sender_failed
             )
         ]
-        live_peers = [p for p in range(self.cfg.num_peers) if p not in failed]
+        live_peers = [p for p in self.committee if p not in failed]
         verified: list[int] = []
         for tid in trainer_ids:
             expected = self._payload(round_idx, tid, digests[tid])
@@ -200,7 +223,7 @@ class _TrustPlane:
                 verified.append(tid)
         for bc in self.broadcasters:
             bc.prune(round_idx)
-        return self.cfg.num_peers - len(failed), failed, verified
+        return len(self.committee) - len(failed), failed, verified
 
 
 class Experiment:
@@ -253,11 +276,19 @@ class Experiment:
             from p2pdl_tpu.protocol.secure_keys import SecureAggKeyring
 
             self.secure_keyring = SecureAggKeyring(cfg.num_peers, seed=cfg.seed)
-            # O(P^2/2) ECDH once per experiment (~1min at P=1024; a
-            # simulation artifact — deployed peers each do O(P) in
-            # parallel). Shares only matter where dropout recovery can run
-            # (the gated pipeline), so don't pay Shamir on the fused path.
-            pair_seeds = self.secure_keyring.seed_matrix()
+            if cfg.secure_agg_rekey == "round":
+                # Per-round rekey derives a fresh matrix at the top of every
+                # round (run_round) — the setup matrix would be dead cost
+                # (O(P^2/2) ECDH), so start from a zero placeholder of the
+                # right shape/dtype.
+                pair_seeds = np.zeros((cfg.num_peers, cfg.num_peers, 2), np.uint32)
+            else:
+                # O(P^2/2) ECDH once per experiment (~1min at P=1024; a
+                # simulation artifact — deployed peers each do O(P) in
+                # parallel). Shares only matter where dropout recovery can
+                # run (the gated pipeline), so don't pay Shamir on the
+                # fused path.
+                pair_seeds = self.secure_keyring.seed_matrix()
             self._seed_mat = pair_seeds
         # Layouts with the trust plane on use a split (two-program) round so
         # the BRB verdict lands BETWEEN the phases: sync layouts gate the
@@ -269,7 +300,18 @@ class Experiment:
         self.round_fn = None
         if self._gated:
             if self.secure_keyring is not None:
-                self.secure_keyring.distribute_shares()
+                committees = None
+                if cfg.secure_agg_rekey == "round" and cfg.secure_agg_neighbors:
+                    # Bell k-ring at scale: shares live with each peer's
+                    # 2k-neighbor committee on the static id ring, so the
+                    # per-round share refresh is O(k^2) field ops per
+                    # rotated peer instead of O(P x t).
+                    from p2pdl_tpu.protocol.secure_keys import ring_committees
+
+                    committees = ring_committees(
+                        cfg.num_peers, cfg.secure_agg_neighbors
+                    )
+                self.secure_keyring.distribute_shares(committees=committees)
                 self._pair_seeds_dev = jnp.asarray(pair_seeds)
             self.train_fn, self.agg_fn = build_trust_round_fns(
                 cfg, self.mesh, attack=attack, pair_seeds=pair_seeds
@@ -416,17 +458,31 @@ class Experiment:
                 self.secure_keyring is not None
                 and self.cfg.secure_agg_rekey == "round"
             ):
-                # Full Bonawitz per-execution freshness: every peer gets a
-                # new ECDH keypair + Shamir shares for THIS round, so a
-                # reconstructed scalar can ever disclose exactly one
-                # round's masks. Generation = absolute round index + 1, so
-                # a checkpoint resume re-derives the SAME key schedule as
-                # the uninterrupted run (bit-exact resume, and no scalar
-                # ever serves two rounds). Fresh matrix object per round —
-                # the previous round's device array is never touched.
-                for pid in range(self.cfg.num_peers):
-                    self.secure_keyring.rotate(pid, generation=r + 1)
-                self._seed_mat = self.secure_keyring.seed_matrix()
+                # Full Bonawitz per-execution freshness: fresh ECDH keypair
+                # + Shamir shares for THIS round, so a reconstructed scalar
+                # can ever disclose exactly one round's masks. Generation =
+                # absolute round index + 1, so a checkpoint resume
+                # re-derives the SAME key schedule as the uninterrupted run
+                # (bit-exact resume, and no scalar ever serves two rounds).
+                # Fresh matrix object per round — the previous round's
+                # device array is never touched.
+                if self.cfg.secure_agg_neighbors:
+                    # Bell k-ring: only the round's ring pairs ever mask,
+                    # so rotate the round's (pre-gate) trainers and derive
+                    # O(T*k) pair seeds — per-round freshness at 1024+
+                    # peers. Unsampled peers keep their last-generation
+                    # scalar; no pair of theirs is used this round, and a
+                    # later rotation jumps straight to that round's
+                    # generation (explicit index, not a counter bump).
+                    for pid in sorted({int(t) for t in trainers if t >= 0}):
+                        self.secure_keyring.rotate(pid, generation=r + 1)
+                    self._seed_mat = self.secure_keyring.seed_matrix_ring(
+                        trainers, self.cfg.secure_agg_neighbors
+                    )
+                else:
+                    for pid in range(self.cfg.num_peers):
+                        self.secure_keyring.rotate(pid, generation=r + 1)
+                    self._seed_mat = self.secure_keyring.seed_matrix()
                 self._pair_seeds_dev = jnp.asarray(self._seed_mat)
             # BRB-gated pipeline: train -> digest+BRB -> gated aggregate.
             with self.profiler.phase("round"):

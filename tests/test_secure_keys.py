@@ -142,3 +142,79 @@ def test_rotate_entropy_mode():
     before = kr.pair_seed(1, 2)
     kr.rotate(1)
     assert kr.pair_seed(1, 2) != before
+
+
+def test_ring_pairs_mirrors_device_partner_ids():
+    """The host pairing mirror (``ring_pairs``, what the per-round rekey
+    fills) covers every pair the device-side ``_partner_ids`` actually
+    uses — including -1 vacancies and the n_live <= neighbors wrap —
+    so no used pair ever masks under an unfilled zero seed."""
+    import jax.numpy as jnp
+
+    from p2pdl_tpu.ops.secure_agg import _partner_ids
+    from p2pdl_tpu.protocol.secure_keys import ring_pairs
+
+    rng = random.Random(3)
+    for trial in range(30):
+        t = rng.choice([4, 6, 8, 12])
+        ids = rng.sample(range(100), t)
+        # Random vacancy pattern (incl. none); keep >= 2 live.
+        for pos in range(t):
+            if rng.random() < 0.25 and sum(i >= 0 for i in ids) > 2:
+                ids[pos] = -1
+        k = rng.choice([0, 2, 4, t])
+        vec = jnp.asarray(ids, jnp.int32)
+        want = ring_pairs(ids, k)
+        used = set()
+        for i in ids:
+            if i < 0:
+                continue
+            for p in np.asarray(_partner_ids(vec, jnp.int32(i), k)).tolist():
+                if p >= 0 and p != i:
+                    used.add((min(i, p), max(i, p)))
+        missing = used - want
+        assert not missing, (ids, k, missing)
+
+
+def test_committee_shares_recover_and_reject():
+    """Committee-held shares (Bell k-ring at scale): a dropped peer's row
+    reconstructs from a committee majority, non-members hold nothing, and
+    below-majority subsets are rejected."""
+    from p2pdl_tpu.protocol.secure_keys import ring_committees
+
+    kr = SecureAggKeyring(12, seed=9)
+    committees = ring_committees(12, 2)  # 4 holders each, threshold 3
+    kr.distribute_shares(rng=random.Random(0), committees=committees)
+    dropped = 5
+    assert committees[dropped] == [6, 4, 7, 3]
+    row = kr.reconstruct_seeds_for_dropped(dropped, [6, 4, 7])
+    assert (row == kr.seed_matrix()[dropped]).all()
+    # Extra non-member ids are ignored, not counted toward the threshold.
+    with pytest.raises(ValueError):
+        kr.reconstruct_seeds_for_dropped(dropped, [6, 4, 0, 1, 2, 8])
+    with pytest.raises(ValueError):
+        kr.share_of(dropped, 0)
+    # Rotation refreshes the committee shares in place.
+    kr.rotate(dropped, rng=random.Random(1))
+    row2 = kr.reconstruct_seeds_for_dropped(dropped, [3, 6, 7])
+    assert (row2 == kr.seed_matrix()[dropped]).all()
+    assert (row2 != row).any()
+
+
+def test_seed_matrix_ring_fills_exactly_the_used_pairs():
+    from p2pdl_tpu.protocol.secure_keys import ring_pairs
+
+    kr = SecureAggKeyring(16, seed=4)
+    trainers = [14, 2, 9, 5, 11, 0, -1, 7]
+    k = 4
+    mat = kr.seed_matrix_ring(trainers, k)
+    full = kr.seed_matrix()
+    pairs = ring_pairs(trainers, k)
+    for i in range(16):
+        for j in range(16):
+            if i == j:
+                continue
+            if (min(i, j), max(i, j)) in pairs:
+                assert (mat[i, j] == full[i, j]).all(), (i, j)
+            else:
+                assert (mat[i, j] == 0).all(), (i, j)

@@ -329,6 +329,12 @@ def test_secure_rekey_round_config_validation():
             secure_agg_rekey="round", aggregator="secure_fedavg",
             brb_enabled=True, num_peers=512, trainers_per_round=8,
         )
+    # The Bell k-ring lifts the cap: per-round rekey is O(T*k) ECDH there.
+    Config(
+        secure_agg_rekey="round", aggregator="secure_fedavg",
+        brb_enabled=True, num_peers=1024, trainers_per_round=8,
+        samples_per_peer=8, batch_size=8, secure_agg_neighbors=4,
+    )
 
 
 def test_secure_rekey_round_fresh_keys_correct_aggregate(mesh8):
@@ -351,6 +357,87 @@ def test_secure_rekey_round_fresh_keys_correct_aggregate(mesh8):
     plain.run_round(trainers=np.asarray(TRAINERS))
     plain.run_round(trainers=np.asarray(TRAINERS))
     _assert_trees_close(exp.state.params, plain.state.params, atol=1e-4)
+
+
+def test_secure_rekey_ring_matches_plain_fedavg(mesh8):
+    """k-ring per-round rekey (the >256-peer mode): fresh ring-pair seeds
+    every round, committee-held shares — and the masked trajectory still
+    equals plain fedavg (ring masks from per-round keys cancel exactly)."""
+    cfg = CFG.replace(
+        num_peers=16, trainers_per_round=6, brb_enabled=True,
+        aggregator="secure_fedavg", secure_agg_rekey="round",
+        secure_agg_neighbors=4,
+    )
+    trainers = [1, 3, 6, 9, 12, 15]
+    exp = Experiment(cfg)
+    assert exp.secure_keyring._committees is not None
+    mats = [exp._seed_mat.copy()]
+    for _ in range(2):
+        exp.run_round(trainers=np.asarray(trainers))
+        mats.append(exp._seed_mat.copy())
+    # Placeholder -> round-1 ring matrix -> round-2 ring matrix: fresh
+    # seeds each round, and only ring pairs filled (peers 0 and 2 are
+    # never sampled, so their rows stay zero).
+    assert (mats[1] != mats[2]).any()
+    assert (mats[2][0] == 0).all() and (mats[2][2] == 0).all()
+    assert (mats[2][1, 3] != 0).any()
+
+    plain = Experiment(CFG.replace(num_peers=16, trainers_per_round=6))
+    for _ in range(2):
+        plain.run_round(trainers=np.asarray(trainers))
+    _assert_trees_close(exp.state.params, plain.state.params, atol=1e-4)
+
+
+def test_brb_committee_matches_full_quorum(mesh8):
+    """Committee-scoped BRB (the O(m^2) control plane for 1024+ peers):
+    with every broadcast delivering, a committee verdict admits the same
+    trainers and produces the same params as the all-peers quorum."""
+    full, rec_f = _params_after_round(CFG.replace(brb_enabled=True), TRAINERS, mesh8)
+    comm, rec_c = _params_after_round(
+        CFG.replace(brb_enabled=True, brb_committee=7), TRAINERS, mesh8
+    )
+    assert len(comm.trust.committee) == 7
+    assert rec_f.brb_excluded_trainers == rec_c.brb_excluded_trainers == []
+    _assert_trees_close(full.state.params, comm.state.params)
+
+
+def test_brb_committee_still_excludes_equivocator(mesh8):
+    """An equivocating trainer splits its SEND across the committee halves
+    — the committee quorum catches it exactly like the full quorum."""
+    victim = TRAINERS[1]
+    cfg = CFG.replace(brb_enabled=True, brb_committee=7)
+    exp = Experiment(cfg, byz_ids=(victim,))
+    rec = exp.run_round(trainers=np.asarray(TRAINERS))
+    assert victim in rec.brb_excluded_trainers
+    expected, _ = _params_after_round(
+        CFG, [t if t != victim else -1 for t in TRAINERS], mesh8
+    )
+    _assert_trees_close(exp.state.params, expected.state.params)
+
+
+@pytest.mark.slow
+def test_secure_rekey_ring_1024_peers(mesh8):
+    """The flagship secure scale: a BRB-gated masked round at 1024 peers
+    with per-round k-ring rekeying — the config the O(P^2) cap used to
+    reject — over a 32-member BRB committee (the O(P^2) Bracha fan-out
+    would otherwise blow the round timeout in-process). One gated round
+    completes with finite loss and the round's seed matrix carries fresh
+    ring-pair seeds only."""
+    cfg = CFG.replace(
+        num_peers=1024, trainers_per_round=8, samples_per_peer=8,
+        batch_size=8, brb_enabled=True, aggregator="secure_fedavg",
+        secure_agg_rekey="round", secure_agg_neighbors=4, local_epochs=1,
+        brb_committee=32,
+    )
+    trainers = [3, 100, 257, 400, 511, 700, 900, 1023]
+    exp = Experiment(cfg)
+    rec = exp.run_round(trainers=np.asarray(trainers))
+    assert rec.brb_excluded_trainers == []
+    assert np.isfinite(rec.train_loss)
+    mat = exp._seed_mat
+    assert (mat[3, 100] != 0).any()  # ring neighbors by rank among sampled
+    assert (mat[3, 511] == 0).all()  # rank distance 4 > k/2 on the 8-ring
+    assert (mat[5] == 0).all()  # unsampled peer: no pairs derived
 
 
 def test_secure_rekey_round_resume_matches_uninterrupted(tmp_path, mesh8):
