@@ -128,7 +128,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="key freshness: never = per-experiment keyring (gated-out peers "
         "rotated after recovery); round = fresh ECDH keys + Shamir shares "
         "every round (full Bonawitz per-execution semantics; BRB-gated "
-        "secure_fedavg, <= 256 peers)",
+        "secure_fedavg; <= 256 peers with the full mask graph, unlimited "
+        "with --secure-agg-neighbors k)",
     )
     p.add_argument(
         "--peer-chunk",
@@ -146,6 +147,14 @@ def build_parser() -> argparse.ArgumentParser:
         "transients; gathered all-gathers the full update stack",
     )
     p.add_argument("--brb", action="store_true", help="enable the BRB trust plane")
+    p.add_argument(
+        "--brb-committee",
+        type=int,
+        default=0,
+        help="scope the Bracha quorum to a deterministic m-member committee "
+        "(O(m^2) control messages per broadcast instead of O(P^2) — the "
+        "trust plane at 1024+ peers); 0 = every peer votes",
+    )
     p.add_argument("--round-timeout-s", type=float, default=30.0)
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--compute-dtype", default="bfloat16")
@@ -314,6 +323,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         secure_agg_rekey=args.secure_agg_rekey,
         peer_chunk=args.peer_chunk,
         brb_enabled=args.brb,
+        brb_committee=args.brb_committee,
         round_timeout_s=args.round_timeout_s,
         seed=args.seed,
         compute_dtype=args.compute_dtype,

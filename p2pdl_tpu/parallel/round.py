@@ -454,6 +454,16 @@ def _apply_server_opt(cfg: Config, old_params, new_params, m, v):
     return out_p, new_m, new_v
 
 
+def _dp_sharded_tree(params_spec, axis):
+    """Per-leaf bool tree from a model-parallel params spec tree: which
+    leaves are SPLIT over ``axis`` (their delta slices need a psum to
+    complete the DP clip norm, and per-shard noise keys); replicated
+    leaves are full copies and enter the norm once."""
+    return jax.tree.map(
+        lambda s: axis in s, params_spec, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
 def build_round_fn(
     cfg: Config, mesh: Mesh, attack: str = "none", pair_seeds=None
 ) -> Callable:
@@ -486,6 +496,15 @@ def build_round_fn(
     )
     opt = make_optimizer(cfg)
     l_per_dev = peers_per_device(cfg.num_peers, mesh)
+    # Per-leaf model-parallel placement, computed ONCE (params: column/row
+    # kernels over tp / expert stacks over ep / depth stacks over pp;
+    # optimizer state mirrors the params — what makes momentum compose
+    # with the sharded axes). Also the single derivation site for the DP
+    # sharded-leaf classification.
+    mp_kind = "tp" if tp_axis else ("ep" if ep_axis else ("pp" if pp_axis else None))
+    mp_specs = _model_parallel_specs(cfg, mp_kind) if mp_kind else None
+    dp_axis = (tp_axis or ep_axis or pp_axis) if cfg.dp_clip > 0.0 else None
+    dp_sharded = _dp_sharded_tree(mp_specs[0], dp_axis) if dp_axis else None
     emit_delta = False
     if params_layout(cfg) == "peer":
         emit_delta = cfg.brb_enabled
@@ -502,20 +521,14 @@ def build_round_fn(
         body = _general_sync_body(
             cfg, attack, model, opt, l_per_dev,
             seq_axis=seq_axis, ep_axis=ep_axis, pair_seeds=pair_seeds,
+            dp_axis=dp_axis, dp_sharded=dp_sharded,
         )
         params_spec = P()
     sp = P(PEER_AXIS)
     sr = P()
     opt_spec = sp
-    # Per-leaf placement (params: column/row kernels over tp / expert stacks
-    # over ep / depth stacks over pp; optimizer state mirrors the params —
-    # what makes momentum compose with the sharded axes).
-    if tp_axis is not None:
-        params_spec, opt_spec = _model_parallel_specs(cfg, "tp")
-    elif ep_axis is not None:
-        params_spec, opt_spec = _model_parallel_specs(cfg, "ep")
-    elif pp_axis is not None:
-        params_spec, opt_spec = _model_parallel_specs(cfg, "pp")
+    if mp_specs is not None:
+        params_spec, opt_spec = mp_specs
 
     # Inputs [P, S, ...]: under sequence parallelism the third dimension
     # (image height for ViT — the stride-aligned patch stem makes row blocks
@@ -660,6 +673,12 @@ def build_multi_round_fn(
     )
     opt = make_optimizer(cfg)
     l_per_dev = peers_per_device(cfg.num_peers, mesh)
+    # One derivation site for model-parallel placement + the DP
+    # sharded-leaf classification (same structure as build_round_fn).
+    mp_kind = "tp" if tp_axis else ("ep" if ep_axis else ("pp" if pp_axis else None))
+    mp_specs = _model_parallel_specs(cfg, mp_kind) if mp_kind else None
+    dp_axis = (tp_axis or ep_axis or pp_axis) if cfg.dp_clip > 0.0 else None
+    dp_sharded = _dp_sharded_tree(mp_specs[0], dp_axis) if dp_axis else None
     if params_layout(cfg) == "peer":
         body = _gossip_body(cfg, mesh, attack, model, opt, l_per_dev, emit_delta=False)
         params_spec = P(PEER_AXIS)
@@ -673,17 +692,14 @@ def build_multi_round_fn(
         body = _general_sync_body(
             cfg, attack, model, opt, l_per_dev,
             seq_axis=seq_axis, ep_axis=ep_axis, pair_seeds=pair_seeds,
+            dp_axis=dp_axis, dp_sharded=dp_sharded,
         )
         params_spec = P()
     sp = P(PEER_AXIS)
     sr = P()
     opt_spec = sp
-    if tp_axis is not None:
-        params_spec, opt_spec = _model_parallel_specs(cfg, "tp")
-    elif ep_axis is not None:
-        params_spec, opt_spec = _model_parallel_specs(cfg, "ep")
-    elif pp_axis is not None:
-        params_spec, opt_spec = _model_parallel_specs(cfg, "pp")
+    if mp_specs is not None:
+        params_spec, opt_spec = mp_specs
 
     def multi_body(
         params, opt_state, server_m, server_v, extras, rng, x, y, trainer_mat, byz_gate, round0, base_key
@@ -1093,7 +1109,52 @@ def _local_train_phase(
     return phase
 
 
-def _aggregate_phase(cfg, l_per_dev, pair_seeds=None, gated=False, runtime_seeds=False):
+def _dp_noise_tree(cfg, agg, mask_key, dp_axis=None, dp_sharded=None):
+    """Gaussian mechanism on the clipped mean: std = z * C / T_cfg (the
+    fixed DP denominator). The key derives from the replicated mask_key,
+    so every device adds the IDENTICAL draw and peers stay in lockstep —
+    which also makes the chunked and general bodies' noisy rounds
+    bit-equal (shared helper, same per-leaf key schedule). Under a
+    model-parallel layout (``dp_axis``), sharded leaves fold the shard
+    index in so equal-shaped slices draw INDEPENDENT noise (correlated
+    slice noise would have off-spec covariance after the logical concat);
+    replicated leaves keep the shared key — they must stay bit-identical
+    across shards. Noise adds in float32 and casts ONCE afterwards:
+    casting the noise to a low-precision leaf dtype BEFORE the add would
+    quantize it to the leaf's ulp grid (a discretized Gaussian breaks the
+    continuous-mechanism RDP bound); quantizing the already-noised sum is
+    data-independent post-processing, which preserves DP."""
+    noise_key = jax.random.fold_in(mask_key, 0x6D70)  # "dp"
+    std = cfg.dp_noise_multiplier * cfg.dp_clip / cfg.trainers_per_round
+    leaves, treedef = jax.tree_util.tree_flatten(agg)
+    keys = list(jax.random.split(noise_key, len(leaves)))
+    if dp_axis is not None:
+        ax = lax.axis_index(dp_axis)
+        keys = [
+            jax.random.fold_in(k, ax) if s else k
+            for k, s in zip(keys, jax.tree.leaves(dp_sharded))
+        ]
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [
+            (
+                l.astype(jnp.float32)
+                + std * jax.random.normal(k, l.shape, jnp.float32)
+            ).astype(l.dtype)
+            for l, k in zip(leaves, keys)
+        ],
+    )
+
+
+def _dp_clip_scale(cfg, sq):
+    """``min(1, C / ||delta||)`` per peer from the summed squares ``sq``."""
+    return jnp.minimum(1.0, cfg.dp_clip / jnp.maximum(jnp.sqrt(sq), 1e-12))
+
+
+def _aggregate_phase(
+    cfg, l_per_dev, pair_seeds=None, gated=False, runtime_seeds=False,
+    dp_axis=None, dp_sharded=None,
+):
     """Phase fragment (inside ``shard_map``): admit the trainer-gated deltas
     into the aggregate, apply one deterministic server update, and advance
     only trainers' optimizer state — the reference's tester-side
@@ -1112,7 +1173,18 @@ def _aggregate_phase(cfg, l_per_dev, pair_seeds=None, gated=False, runtime_seeds
     ``runtime_seeds=True`` (the gated driver path) takes the seed matrix as
     a trailing RUNTIME argument instead of a baked constant, so key ROTATION
     after a dropout-recovery event (``SecureAggKeyring.rotate``) swaps in
-    fresh seeds without recompiling."""
+    fresh seeds without recompiling.
+
+    ``dp_axis``/``dp_sharded`` (a mesh-axis name + a per-leaf bool tree,
+    set when DP composes with a model-parallel layout): each device holds
+    only a SLICE of a peer's update for the sharded leaves, so the clip
+    norm is completed by a ``psum`` of those leaves' partial squares over
+    the model axis (replicated leaves contribute once — a blind psum
+    would overcount them ``shards``-fold and under-clip nothing but
+    OVER-count sensitivity), and the noise key folds in the shard index
+    for sharded leaves only, so equal-shaped slices draw independent
+    noise while replicated leaves stay bit-identical across shards (the
+    shard_map vma check enforces the latter)."""
     const = None if runtime_seeds else (
         jnp.asarray(pair_seeds) if pair_seeds is not None else None
     )
@@ -1128,15 +1200,24 @@ def _aggregate_phase(cfg, l_per_dev, pair_seeds=None, gated=False, runtime_seeds
             # L2 contribution BEFORE masking and aggregation — on the raw
             # delta, exactly what a DP client would ship (composes with
             # secure aggregation: clip locally, then mask).
-            sq = sum(
-                jnp.sum(
+            def leaf_sq(d):
+                return jnp.sum(
                     d.astype(jnp.float32).reshape(l_per_dev, -1) ** 2, axis=1
                 )
-                for d in jax.tree.leaves(delta)
-            )
-            clip_scale = jnp.minimum(
-                1.0, cfg.dp_clip / jnp.maximum(jnp.sqrt(sq), 1e-12)
-            )  # [L]
+
+            if dp_axis is None:
+                sq = sum(leaf_sq(d) for d in jax.tree.leaves(delta))
+            else:
+                # Model-parallel layout: complete the global per-peer L2
+                # over the model axis (sharded leaves hold slices);
+                # replicated leaves enter once, outside the psum.
+                zero = jnp.zeros((l_per_dev,), jnp.float32)
+                flags = jax.tree.leaves(dp_sharded)
+                parts = jax.tree.leaves(delta)
+                sh = sum((leaf_sq(d) for d, s in zip(parts, flags) if s), zero)
+                rep = sum((leaf_sq(d) for d, s in zip(parts, flags) if not s), zero)
+                sq = lax.psum(sh, dp_axis) + rep
+            clip_scale = _dp_clip_scale(cfg, sq)  # [L]
             delta = jax.tree.map(
                 lambda d: (
                     d.astype(jnp.float32)
@@ -1222,31 +1303,7 @@ def _aggregate_phase(cfg, l_per_dev, pair_seeds=None, gated=False, runtime_seeds
             )
 
         if cfg.dp_noise_multiplier > 0.0:
-            # Gaussian mechanism on the clipped mean: std = z * C / T_live
-            # (count is defined here — validation restricts DP to the mean
-            # family). The key derives from the replicated mask_key, so
-            # every device adds the IDENTICAL draw and peers stay in
-            # lockstep.
-            noise_key = jax.random.fold_in(mask_key, 0x6D70)  # "dp"
-            # Static std (the fixed DP denominator, not the live count).
-            std = cfg.dp_noise_multiplier * cfg.dp_clip / cfg.trainers_per_round
-            leaves, treedef = jax.tree_util.tree_flatten(agg)
-            keys = jax.random.split(noise_key, len(leaves))
-            # Add in float32 and cast ONCE afterwards: casting the noise to
-            # a low-precision leaf dtype BEFORE the add would quantize it to
-            # the leaf's ulp grid (a discretized Gaussian breaks the
-            # continuous-mechanism RDP bound); quantizing the already-noised
-            # sum is data-independent post-processing, which preserves DP.
-            agg = jax.tree_util.tree_unflatten(
-                treedef,
-                [
-                    (
-                        l.astype(jnp.float32)
-                        + std * jax.random.normal(k, l.shape, jnp.float32)
-                    ).astype(l.dtype)
-                    for l, k in zip(leaves, keys)
-                ],
-            )
+            agg = _dp_noise_tree(cfg, agg, mask_key, dp_axis, dp_sharded)
 
         # Server update (reference applies 0.1 * avg_delta in place,
         # ``aggregator/aggregation.py:36-38``); peers stay in lockstep.
@@ -1329,9 +1386,14 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
         round_keys = jax.vmap(lambda k: jax.random.fold_in(k, round_idx))(rng)
         pvaried = jax.lax.pcast(params, PEER_AXIS, to="varying")
         is_trainer_all = jnp.isin(local_ids, trainer_idx)
-        count = jnp.maximum(
-            lax.psum(jnp.sum(is_trainer_all.astype(jnp.float32)), PEER_AXIS), 1.0
-        )
+        if cfg.dp_clip > 0.0:
+            # FIXED DP denominator (same rationale as the general body:
+            # a data-dependent count would double the certified spend).
+            count = jnp.float32(cfg.trainers_per_round)
+        else:
+            count = jnp.maximum(
+                lax.psum(jnp.sum(is_trainer_all.astype(jnp.float32)), PEER_AXIS), 1.0
+            )
 
         def to_chunks(leaf):
             return leaf.reshape((n_chunks, chunk) + leaf.shape[1:])
@@ -1374,6 +1436,23 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
             else:
                 delta = apply_attack(
                     attack, delta, gate_c, mask_key, peer_ids=ids_c
+                )
+            if cfg.dp_clip > 0.0:
+                # Per-peer L2 clip INSIDE the chunk — same order as the
+                # general body (post-attack, pre-masking), so chunked DP
+                # rounds equal unchunked ones bit-for-bit. Adaptive
+                # envelopes are clipped once post-scan (below).
+                sq = sum(
+                    jnp.sum(d.astype(jnp.float32).reshape(chunk, -1) ** 2, axis=1)
+                    for d in jax.tree.leaves(delta)
+                )
+                scale = _dp_clip_scale(cfg, sq)  # [chunk]
+                delta = jax.tree.map(
+                    lambda d: (
+                        d.astype(jnp.float32)
+                        * scale.reshape((chunk,) + (1,) * (d.ndim - 1))
+                    ).astype(d.dtype),
+                    delta,
                 )
             if cfg.aggregator == "secure_fedavg":
                 delta = jax.vmap(
@@ -1419,31 +1498,42 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
             n_h = jnp.maximum(n_h, 1.0)
 
             if alie:
-                def envelope(a, m1, m2):
+                def bad_of(m1, m2):
                     mean = m1 / n_h.astype(m1.dtype)
                     var = jnp.maximum(m2 / n_h.astype(m2.dtype) - mean * mean, 0.0)
-                    bad = mean - jnp.asarray(ALIE_Z, mean.dtype) * jnp.sqrt(var)
-                    return a + n_bt.astype(a.dtype) * bad
+                    return mean - jnp.asarray(ALIE_Z, mean.dtype) * jnp.sqrt(var)
 
-                acc = jax.tree.map(
-                    envelope,
-                    jax.tree.map(lambda a: lax.psum(a, PEER_AXIS), acc), s1, s2,
-                )
+                bad = jax.tree.map(bad_of, s1, s2)
             else:
-                def envelope(a, m1):
-                    mean = m1 / n_h.astype(m1.dtype)
-                    bad = -jnp.asarray(IPM_EPS, mean.dtype) * mean
-                    return a + n_bt.astype(a.dtype) * bad
-
-                acc = jax.tree.map(
-                    envelope,
-                    jax.tree.map(lambda a: lax.psum(a, PEER_AXIS), acc), s1,
+                bad = jax.tree.map(
+                    lambda m1: -jnp.asarray(IPM_EPS, m1.dtype)
+                    * (m1 / n_h.astype(m1.dtype)),
+                    s1,
                 )
+            if cfg.dp_clip > 0.0:
+                # Every adaptive attacker ships the SAME envelope vector;
+                # the general body clips each copy with the identical
+                # scale, so clipping the envelope once and adding n_bt
+                # copies is exact.
+                bsq = sum(
+                    jnp.sum(b.astype(jnp.float32) ** 2)
+                    for b in jax.tree.leaves(bad)
+                )
+                bscale = _dp_clip_scale(cfg, bsq)
+                bad = jax.tree.map(
+                    lambda b: (b.astype(jnp.float32) * bscale).astype(b.dtype), bad
+                )
+            acc = jax.tree.map(
+                lambda a, b: lax.psum(a, PEER_AXIS) + n_bt.astype(a.dtype) * b,
+                acc, bad,
+            )
             agg = jax.tree.map(lambda a: a / count.astype(a.dtype), acc)
         else:
             agg = jax.tree.map(
                 lambda a: lax.psum(a, PEER_AXIS) / count.astype(a.dtype), acc
             )
+        if cfg.dp_noise_multiplier > 0.0:
+            agg = _dp_noise_tree(cfg, agg, mask_key)
         new_p = jax.tree.map(
             lambda p, a: p + cfg.server_lr * a.astype(p.dtype), params, agg
         )
@@ -1455,7 +1545,8 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
 
 
 def _general_sync_body(
-    cfg, attack, model, opt, l_per_dev, seq_axis=None, ep_axis=None, pair_seeds=None
+    cfg, attack, model, opt, l_per_dev, seq_axis=None, ep_axis=None,
+    pair_seeds=None, dp_axis=None, dp_sharded=None,
 ):
     """Role-based round over single-copy global params: broadcast the global
     model into a vmapped local-SGD phase (peers diverge only transiently),
@@ -1465,7 +1556,10 @@ def _general_sync_body(
         cfg, attack, model, opt, l_per_dev,
         seq_axis=seq_axis, ep_axis=ep_axis, with_bias=cfg.scaffold,
     )
-    agg = _aggregate_phase(cfg, l_per_dev, pair_seeds=pair_seeds)
+    agg = _aggregate_phase(
+        cfg, l_per_dev, pair_seeds=pair_seeds,
+        dp_axis=dp_axis, dp_sharded=dp_sharded,
+    )
 
     if cfg.compress != "none":
         # EF top-k sparsification (ops/compression.py). Per round:

@@ -178,6 +178,7 @@ def test_fused_equals_sequential(mesh8):
             )
 
 
+@pytest.mark.slow
 def test_compression_composes_with_robust_aggregation(mesh8):
     """Sparsified deltas through blockwise Krum: the round runs and the
     sparse updates still carry enough signal to learn."""

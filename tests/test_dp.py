@@ -139,12 +139,13 @@ def test_config_validation():
         Config(**CFG, dp_noise_multiplier=1.0)  # noise without clip
     with pytest.raises(ValueError, match="mean-family"):
         Config(**CFG, dp_clip=1.0, aggregator="krum", byzantine_f=1)
-    with pytest.raises(ValueError, match="peer_chunk"):
-        Config(
-            **{**CFG, "local_epochs": 1, "momentum": 0.0},
-            dp_clip=1.0,
-            peer_chunk=4,
-        )
+    # Formerly rejected compositions, now supported (equivalence-tested in
+    # test_peer_chunk / this file's model-parallel tests):
+    Config(**{**CFG, "local_epochs": 1, "momentum": 0.0}, dp_clip=1.0, peer_chunk=4)
+    Config(
+        **{**_MP_BASE, "vit_heads": 4}, tp_shards=2, dp_clip=1.0,
+        dp_noise_multiplier=1.1,
+    )
 
 
 def test_driver_records_epsilon(tmp_path, mesh8):
@@ -165,13 +166,97 @@ def test_driver_records_epsilon(tmp_path, mesh8):
     assert abs(eps[1] - want) < 1e-3
 
 
-def test_config_rejects_model_parallel_dp():
-    with pytest.raises(ValueError, match="model-parallel"):
-        Config(
-            num_peers=4, trainers_per_round=2, model="vit_tiny",
-            dataset="cifar10", vit_pool="mean", vit_heads=4, vit_depth=2,
-            tp_shards=2, dp_clip=1.0,
+_MP_BASE = dict(
+    num_peers=4, trainers_per_round=2, local_epochs=1, samples_per_peer=8,
+    batch_size=4, model="vit_tiny", dataset="cifar10", vit_depth=2,
+    compute_dtype="float32", lr=0.05, server_lr=1.0,
+)
+
+
+def _mp_round(cfg, n_devices, key=0, **mesh_kw):
+    from p2pdl_tpu.parallel.mesh import data_sharding, make_mesh
+
+    mesh = make_mesh(n_devices, **mesh_kw)
+    data = make_federated_data(cfg, eval_samples=8)
+    state = shard_state(init_peer_state(cfg), cfg, mesh)
+    x = jax.device_put(data.x, data_sharding(mesh))
+    y = jax.device_put(data.y, peer_sharding(mesh))
+    fn = build_round_fn(cfg, mesh)
+    state, _ = fn(
+        state, x, y, jnp.asarray([0, 2], jnp.int32), jnp.zeros(4),
+        jax.random.PRNGKey(key),
+    )
+    return state
+
+
+@pytest.mark.parametrize(
+    "knobs",
+    [
+        {"tp_shards": 2, "vit_heads": 4},
+        pytest.param(
+            {"ep_shards": 2, "moe_experts": 4, "moe_capacity_factor": 4.0},
+            marks=pytest.mark.slow,
+        ),
+        pytest.param(
+            {"pp_shards": 2, "vit_scan_blocks": True}, marks=pytest.mark.slow
+        ),
+    ],
+    ids=["tp", "ep", "pp"],
+)
+def test_dp_clip_model_parallel_matches_dense(mesh8, knobs):
+    """DP clipping composes with tp/ep/pp: the aggregate phase completes
+    each peer's L2 norm over the model axis (psum of sharded leaves'
+    partials, replicated leaves once), so a BINDING clip produces the
+    identical round as the dense twin — sensitivity is exactly C."""
+    base = Config(**{**_MP_BASE, **knobs}, dp_clip=1e-3)
+    sharded = _mp_round(
+        base, 8,
+        tp_shards=base.tp_shards, ep_shards=base.ep_shards,
+        pp_shards=base.pp_shards,
+    )
+    dense = _mp_round(base.replace(tp_shards=1, ep_shards=1, pp_shards=1), 4)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(sharded.params),
+        jax.tree_util.tree_leaves_with_path(dense.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5,
+            err_msg=jax.tree_util.keystr(pa),
         )
+
+
+def test_dp_noise_tp_slices_independent(mesh8):
+    """Under tp the column-parallel kernels' equal-shaped slices must draw
+    INDEPENDENT noise (the shard index is folded into sharded leaves'
+    keys): with a shared key the two halves of the logical noise field
+    would be bit-identical. Also pins the calibrated std z*C/T on the
+    full model-parallel aggregate."""
+    z, c, t = 4.0, 0.5, 2
+    base = Config(**_MP_BASE, vit_heads=4, tp_shards=2, dp_clip=c)
+    noisy_cfg = Config(
+        **_MP_BASE, vit_heads=4, tp_shards=2, dp_clip=c, dp_noise_multiplier=z
+    )
+    clean = _mp_round(base, 8, tp_shards=2)
+    noisy = _mp_round(noisy_cfg, 8, tp_shards=2)
+    noise = {
+        jax.tree_util.keystr(p): np.asarray(a, np.float64) - np.asarray(b, np.float64)
+        for (p, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(noisy.params),
+            jax.tree_util.tree_leaves_with_path(clean.params),
+        )
+    }
+    # Column-parallel fc1 kernel: logical [dim, hidden], shards hold the
+    # two hidden halves. Equal halves == shared-key bug.
+    fc1 = next(v for k, v in noise.items() if "TransformerBlock_0" in k
+               and "Dense_0" in k and "kernel" in k)
+    lo, hi = np.split(fc1, 2, axis=-1)
+    assert not np.allclose(lo, hi), "tp slices drew identical noise"
+    assert abs(np.corrcoef(lo.ravel(), hi.ravel())[0, 1]) < 0.05
+    # Calibrated magnitude on the whole tree (server_lr=1: params diff IS
+    # the noised aggregate diff).
+    flat = np.concatenate([v.ravel() for v in noise.values()])
+    want_std = z * c / t
+    assert abs(float(flat.std()) - want_std) < 0.15 * want_std
 
 
 def test_fixed_denominator_under_vacancy(mesh8):

@@ -105,7 +105,7 @@ def test_moe_vit_forward_has_expert_grads():
     nonzero gradients (top-1 routing spreads tokens across experts at
     init because the gate is randomly initialized)."""
     model = ViTTiny(depth=2, moe_experts=4, moe_every=2, pool="mean")
-    x = jax.random.normal(jax.random.PRNGKey(0), (8, 32, 32, 3), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 32, 32, 3), jnp.float32)
     params = model.init(jax.random.PRNGKey(1), x)["params"]
     moe_params = params["TransformerBlock_1"]["MoEFFN_0"]
     assert moe_params["wi"].shape == (4, 192, 768)

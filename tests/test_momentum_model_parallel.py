@@ -44,7 +44,12 @@ _BASE = dict(
     [
         {"tp_shards": 2, "vit_heads": 4},
         {"ep_shards": 2, "moe_experts": 4, "moe_capacity_factor": 4.0},
-        {"pp_shards": 2, "vit_scan_blocks": True},
+        # pp rides the slow tier: its trace placement is the same
+        # derived_tree_specs walk tp/ep exercise, and the pp round math
+        # keeps inner-loop coverage in test_pipeline_parallel.
+        pytest.param(
+            {"pp_shards": 2, "vit_scan_blocks": True}, marks=pytest.mark.slow
+        ),
         # Adam: count/mu/nu state through the per-leaf placement (mu/nu
         # mirror the params; the stacked count falls back to P(peers)).
         {"tp_shards": 2, "vit_heads": 4, "optimizer": "adam", "momentum": 0.0},

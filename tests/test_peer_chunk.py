@@ -90,6 +90,40 @@ def test_chunked_round_matches_general(mesh8, aggregator, attack):
         np.testing.assert_allclose(got[2], want[2], atol=1e-5)
 
 
+@pytest.mark.parametrize(
+    "attack", ["none", pytest.param("alie", marks=pytest.mark.slow)]
+)
+def test_chunked_dp_round_matches_general(mesh8, attack):
+    """DP-FedAvg composes with peer-chunked streaming: the chunk scan
+    clips each peer inside its chunk (a BINDING clip here) and the shared
+    noise helper draws the identical calibrated Gaussian, so the chunked
+    round equals the general round — including the once-clipped adaptive
+    envelope under ALIE."""
+    base = Config(
+        num_peers=16,
+        trainers_per_round=6,
+        local_epochs=2,
+        samples_per_peer=8,
+        batch_size=4,
+        model="mlp",
+        dataset="mnist",
+        compute_dtype="float32",
+        dp_clip=1e-3,
+        dp_noise_multiplier=2.0,
+    )
+    data = make_federated_data(base, eval_samples=32)
+    byz = jnp.zeros(16).at[2].set(1.0).at[9].set(1.0) if attack != "none" else None
+    want = _run_one_round(base, mesh8, data, attack=attack, byz=byz)
+    for chunk in (1, 2):
+        got = _run_one_round(
+            base.replace(peer_chunk=chunk), mesh8, data, attack=attack, byz=byz
+        )
+        tol = 5e-5 if attack == "alie" else 1e-5
+        for a, b in zip(jax.tree.leaves(got[0]), jax.tree.leaves(want[0])):
+            np.testing.assert_allclose(a, b, atol=tol)
+        np.testing.assert_allclose(got[1], want[1], atol=1e-6)
+
+
 def test_chunked_round_large_peer_count(mesh8):
     """128 peers on 8 devices, chunk 4: the streaming path at real stacking
     depth still learns (loss drops over rounds)."""

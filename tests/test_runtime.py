@@ -42,13 +42,24 @@ def test_experiment_runs_and_logs(small_cfg, tmp_path, mesh8):
 _VIT = {"model": "vit_tiny", "dataset": "cifar10", "vit_depth": 2, "num_peers": 4}
 
 
+# The four model-parallel drives each cost 20-42s of ViT compile+run, so
+# they ride the slow tier: their round math has dedicated per-axis
+# equivalence suites in the inner loop, the cheap chunk case keeps the
+# driver's config->mesh->placement wiring covered there, and the driver-
+# level 2-D-mesh path is also executed by every dryrun_multichip run.
 @pytest.mark.parametrize(
     "knobs",
     [
-        {**_VIT, "seq_shards": 2, "vit_pool": "mean"},
-        {**_VIT, "tp_shards": 2, "vit_heads": 4},
-        {**_VIT, "ep_shards": 2, "moe_experts": 4},
-        {**_VIT, "pp_shards": 2},
+        pytest.param(
+            {**_VIT, "seq_shards": 2, "vit_pool": "mean"}, marks=pytest.mark.slow
+        ),
+        pytest.param(
+            {**_VIT, "tp_shards": 2, "vit_heads": 4}, marks=pytest.mark.slow
+        ),
+        pytest.param(
+            {**_VIT, "ep_shards": 2, "moe_experts": 4}, marks=pytest.mark.slow
+        ),
+        pytest.param({**_VIT, "pp_shards": 2}, marks=pytest.mark.slow),
         {"model": "mlp", "dataset": "mnist", "num_peers": 16, "peer_chunk": 2},
     ],
     ids=["seq", "tp", "ep", "pp", "chunk"],
