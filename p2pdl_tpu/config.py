@@ -626,13 +626,16 @@ class Config:
                     "is data-dependent per coordinate and the clip/noise "
                     "calibration does not cover it"
                 )
-            if (
-                self.seq_shards > 1 or self.tp_shards > 1
-                or self.ep_shards > 1 or self.pp_shards > 1
-            ):
+            # Sequence parallelism composes (deltas are replicated across
+            # the seq axis, so the global top-k selection is unchanged and
+            # the residual stack stays peer-placed).
+            if self.tp_shards > 1 or self.ep_shards > 1 or self.pp_shards > 1:
                 raise ValueError(
-                    "compress with model/sequence parallelism is not yet "
-                    "supported (the residual placement is data-parallel)"
+                    "compress with tensor/expert/pipeline parallelism is not "
+                    "yet supported: the top-k threshold is GLOBAL over the "
+                    "full flattened update, but each shard holds only a "
+                    "slice — a per-shard selection would misallocate the "
+                    "budget (needs a cross-shard distributed top-k)"
                 )
         if self.scaffold:
             if self.aggregator != "fedavg":
@@ -669,14 +672,12 @@ class Config:
                     "released state, bypassing the mechanism the epsilon "
                     "accounting certifies"
                 )
-            if (
-                self.seq_shards > 1 or self.tp_shards > 1
-                or self.ep_shards > 1 or self.pp_shards > 1
-            ):
-                raise ValueError(
-                    "scaffold with model/sequence parallelism is not yet "
-                    "supported (the c_i stack placement is data-parallel)"
-                )
+            # Model/sequence parallelism composes: c mirrors the params
+            # placement and the c_i stack places like the optimizer state
+            # (peer axis + each param's spec — parallel/round
+            # _model_parallel_specs extra_specs); the option-II update is
+            # elementwise per leaf slice, so sharded layouts equal the
+            # dense twin (tested per axis).
         if self.fedprox_mu < 0.0:
             raise ValueError(f"fedprox_mu must be >= 0 (0 = off), got {self.fedprox_mu}")
         if self.dp_clip < 0.0:

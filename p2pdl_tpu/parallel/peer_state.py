@@ -213,6 +213,11 @@ def shard_state(state: PeerState, cfg: Config, mesh) -> PeerState:
     opt_shardings = jax.tree.map(
         lambda l: ps if getattr(l, "ndim", 0) >= 1 else rs, state.opt_state
     )
+    # Derived-stack placement for peer-stacked params-shaped families
+    # (optimizer traces, SCAFFOLD c_i, compression residuals): plain
+    # peer-stacked by default, peer axis + the matching param's spec per
+    # leaf under model parallelism.
+    stack_shardings = lambda tree: jax.tree.map(lambda _: ps, tree)  # noqa: E731
     if (cfg.tp_shards > 1 or cfg.ep_shards > 1 or cfg.pp_shards > 1) and layout == "sync":
         from p2pdl_tpu.ops.placement import derived_tree_specs
         from p2pdl_tpu.parallel.mesh import PEER_AXIS
@@ -229,13 +234,15 @@ def shard_state(state: PeerState, cfg: Config, mesh) -> PeerState:
         param_shardings = jax.tree.map(
             lambda spec: NamedSharding(mesh, spec), param_specs, is_leaf=is_spec
         )
-        # Optimizer state mirrors the params (momentum traces): peer axis +
-        # the matching param's spec per leaf.
-        opt_shardings = jax.tree.map(
-            lambda spec: NamedSharding(mesh, spec),
-            derived_tree_specs(state.opt_state, param_specs, PEER_AXIS),
-            is_leaf=is_spec,
-        )
+
+        def stack_shardings(tree):  # noqa: F811
+            return jax.tree.map(
+                lambda spec: NamedSharding(mesh, spec),
+                derived_tree_specs(tree, param_specs, PEER_AXIS),
+                is_leaf=is_spec,
+            )
+
+        opt_shardings = stack_shardings(state.opt_state)
     else:
         param_shardings = jax.tree.map(
             lambda _: ps if layout == "peer" else rs, state.params
@@ -249,11 +256,12 @@ def shard_state(state: PeerState, cfg: Config, mesh) -> PeerState:
         # (same shapes, same model-parallel splits).
         server_m=None if state.server_m is None else param_shardings,
         server_v=None if state.server_v is None else param_shardings,
-        # SCAFFOLD: c replicated like sync params, c_i peer-stacked.
-        # (Config restricts scaffold to the data-parallel sync layout.)
-        scaffold_c=None if state.scaffold_c is None else jax.tree.map(lambda _: rs, state.scaffold_c),
-        scaffold_ci=None if state.scaffold_ci is None else jax.tree.map(lambda _: ps, state.scaffold_ci),
-        compress_err=None if state.compress_err is None else jax.tree.map(lambda _: ps, state.compress_err),
+        # SCAFFOLD: c mirrors the params placement (replicated across
+        # peers, model-axis-sharded under tp/ep/pp); the c_i and residual
+        # stacks place like the optimizer state.
+        scaffold_c=None if state.scaffold_c is None else param_shardings,
+        scaffold_ci=None if state.scaffold_ci is None else stack_shardings(state.scaffold_ci),
+        compress_err=None if state.compress_err is None else stack_shardings(state.compress_err),
     )
     return jax.device_put(state, shardings)
 

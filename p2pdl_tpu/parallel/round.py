@@ -93,15 +93,18 @@ def _mesh_axes_for(
 
 
 def _model_parallel_specs(cfg: Config, kind: str):
-    """(params_spec, opt_spec) per-leaf PartitionSpec trees for a
-    model-parallel layout (one abstract init trace shared by both):
+    """(params_spec, opt_spec, extra_specs) per-leaf PartitionSpec trees
+    for a model-parallel layout (one abstract init trace shared by all):
 
     - params: full logical shapes; ``kind`` selects the placer — "tp"
       (column/row kernels, ``ops.tp``), "ep" (expert-stacked leaves,
       ``ops.moe``), "pp" (depth-stacked block leaves, ``ops.pipeline``);
     - optimizer state: momentum traces mirror the param tree, so each
       trace leaf is its param's spec with the peer axis prefixed
-      (``ops.placement.derived_tree_specs``)."""
+      (``ops.placement.derived_tree_specs``);
+    - ``extra_specs``: same derivation for the other peer-stacked
+      params-shaped state families (SCAFFOLD ``c_i``, compression
+      residuals), present iff the config enables them."""
     from p2pdl_tpu.ops.placement import derived_tree_specs
 
     if kind == "tp":
@@ -114,7 +117,16 @@ def _model_parallel_specs(cfg: Config, kind: str):
     abstract = jax.eval_shape(lambda: init_peer_state(cfg))
     params_spec = placer.param_specs(abstract.params)
     opt_spec = derived_tree_specs(abstract.opt_state, params_spec, PEER_AXIS)
-    return params_spec, opt_spec
+    extra_specs = {}
+    if abstract.scaffold_ci is not None:
+        extra_specs["scaffold_ci"] = derived_tree_specs(
+            abstract.scaffold_ci, params_spec, PEER_AXIS
+        )
+    if abstract.compress_err is not None:
+        extra_specs["compress_err"] = derived_tree_specs(
+            abstract.compress_err, params_spec, PEER_AXIS
+        )
+    return params_spec, opt_spec, extra_specs
 
 
 def make_forward_fn(
@@ -528,7 +540,15 @@ def build_round_fn(
     sr = P()
     opt_spec = sp
     if mp_specs is not None:
-        params_spec, opt_spec = mp_specs
+        params_spec, opt_spec = mp_specs[:2]
+    # Per-round state-family stacks place like the optimizer state: peer
+    # axis + the matching param's spec per leaf under model parallelism,
+    # plain peer-stacked otherwise. The SCAFFOLD server c mirrors the
+    # params placement itself (replicated across peers, sharded across
+    # any model axis exactly as the params are).
+    mp_extra = mp_specs[2] if mp_specs is not None else {}
+    ci_spec = mp_extra.get("scaffold_ci", sp)
+    err_spec = mp_extra.get("compress_err", sp)
 
     # Inputs [P, S, ...]: under sequence parallelism the third dimension
     # (image height for ViT — the stride-aligned patch stem makes row blocks
@@ -536,24 +556,22 @@ def build_round_fn(
     x_spec = P(PEER_AXIS, None, SEQ_AXIS) if seq_axis is not None else sp
     if cfg.scaffold:
         # (params, opt, c, ci, rng, x, y, tid, byz, round, key) ->
-        # (params, opt, losses, c, ci). Config restricts scaffold to the
-        # data-parallel sync layout, so c is a plain replicated tree and
-        # the c_i stack shards like the optimizer state.
+        # (params, opt, losses, c, ci).
         smapped = jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(params_spec, opt_spec, P(), sp, sp, x_spec, sp, sr, sr, sr, sr),
-            out_specs=(params_spec, opt_spec, sp, P(), sp),
+            in_specs=(params_spec, opt_spec, params_spec, ci_spec, sp, x_spec, sp, sr, sr, sr, sr),
+            out_specs=(params_spec, opt_spec, sp, params_spec, ci_spec),
         )
     elif cfg.compress != "none":
         # (params, opt, err, rng, x, y, tid, byz, round, key) ->
         # (params, opt, losses, err). The residual stack shards like the
-        # optimizer state (data-parallel sync layout, config-enforced).
+        # optimizer state.
         smapped = jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(params_spec, opt_spec, sp, sp, x_spec, sp, sr, sr, sr, sr),
-            out_specs=(params_spec, opt_spec, sp, sp),
+            in_specs=(params_spec, opt_spec, err_spec, sp, x_spec, sp, sr, sr, sr, sr),
+            out_specs=(params_spec, opt_spec, sp, err_spec),
         )
     else:
         smapped = jax.shard_map(
@@ -699,7 +717,7 @@ def build_multi_round_fn(
     sr = P()
     opt_spec = sp
     if mp_specs is not None:
-        params_spec, opt_spec = mp_specs
+        params_spec, opt_spec = mp_specs[:2]
 
     def multi_body(
         params, opt_state, server_m, server_v, extras, rng, x, y, trainer_mat, byz_gate, round0, base_key
@@ -747,14 +765,17 @@ def build_multi_round_fn(
     # Extra per-round state rides the scan carry next to the server buffers.
     # ONE list of (PeerState field, spec) pairs drives the spec, the packing,
     # and the state rebuild below — the bodies emit these fields after the
-    # losses in this same order. Config restricts both families to the
-    # data-parallel sync layout, so the server's c is replicated and the
-    # per-peer stacks (c_i, err) shard over the peer axis like the
-    # optimizer state.
+    # losses in this same order. The server's c mirrors the params placement
+    # (replicated across peers, model-axis-sharded under tp/ep/pp); the
+    # per-peer stacks (c_i, err) place like the optimizer state.
+    mp_extra = mp_specs[2] if mp_specs is not None else {}
     if cfg.scaffold:
-        extra_fields = (("scaffold_c", P()), ("scaffold_ci", sp))
+        extra_fields = (
+            ("scaffold_c", params_spec),
+            ("scaffold_ci", mp_extra.get("scaffold_ci", sp)),
+        )
     elif cfg.compress != "none":
-        extra_fields = (("compress_err", sp),)
+        extra_fields = (("compress_err", mp_extra.get("compress_err", sp)),)
     else:
         extra_fields = ()
     extras_spec = tuple(s for _, s in extra_fields)

@@ -179,6 +179,54 @@ def test_fused_equals_sequential(mesh8):
 
 
 @pytest.mark.slow
+def test_compression_seq_parallel_matches_dense(mesh8):
+    """EF top-k composes with sequence parallelism: deltas are replicated
+    across the seq axis, so the global top-k selection and the residual
+    telescoping are unchanged — the (peers x seq) round equals the dense
+    twin, params and residuals. Almost: the seq grads psum in a different
+    reduction order, and top-k is DISCONTINUOUS at the k-th-magnitude
+    boundary, so a float-level delta difference can flip an at-threshold
+    coordinate's selection. The assertion bounds that honestly: ~all
+    coordinates tight, at most a vanishing fraction flipped, and any
+    flipped coordinate off by no more than its own (near-threshold, hence
+    small) shipped magnitude."""
+    from p2pdl_tpu.parallel.mesh import data_sharding, make_mesh
+
+    base = Config(
+        num_peers=4, trainers_per_round=2, local_epochs=1, samples_per_peer=8,
+        batch_size=4, model="vit_tiny", dataset="cifar10", vit_depth=2,
+        vit_pool="mean", compute_dtype="float32", lr=0.05, server_lr=1.0,
+        compress="topk", compress_ratio=0.2, seq_shards=2,
+    )
+    results = {}
+    for sharded in (False, True):
+        cfg = base if sharded else base.replace(seq_shards=1)
+        mesh = make_mesh(8, seq_shards=2) if sharded else make_mesh(4)
+        data = make_federated_data(cfg, eval_samples=8)
+        state = shard_state(init_peer_state(cfg), cfg, mesh)
+        x = jax.device_put(data.x, data_sharding(mesh))
+        y = jax.device_put(data.y, peer_sharding(mesh))
+        fn = build_round_fn(cfg, mesh)
+        for r in range(2):
+            state, _ = fn(
+                state, x, y, jnp.asarray([0, 2], jnp.int32), jnp.zeros(4),
+                jax.random.PRNGKey(r),
+            )
+        results[sharded] = state
+    for field in ("params", "compress_err"):
+        mismatched = total = 0
+        for a, b in zip(
+            jax.tree.leaves(getattr(results[True], field)),
+            jax.tree.leaves(getattr(results[False], field)),
+        ):
+            diff = np.abs(np.asarray(a, np.float64) - np.asarray(b, np.float64))
+            assert float(diff.max(initial=0.0)) < 1e-2, field
+            mismatched += int(np.sum(diff > 3e-5))
+            total += diff.size
+        assert mismatched / total < 1e-4, (field, mismatched, total)
+
+
+@pytest.mark.slow
 def test_compression_composes_with_robust_aggregation(mesh8):
     """Sparsified deltas through blockwise Krum: the round runs and the
     sparse updates still carry enough signal to learn."""

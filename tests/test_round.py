@@ -276,18 +276,22 @@ def test_robust_reducers_under_alie(base_cfg, mesh8):
     cfg = base_cfg.replace(
         aggregator="trimmed_mean", trimmed_mean_beta=0.25, trainers_per_round=8
     )
-    _, losses, ev = _run_rounds(cfg, mesh8, n_rounds=4, attack="alie", byz_ids=(1, 5))
+    _, losses, ev = _run_rounds(cfg, mesh8, n_rounds=2, attack="alie", byz_ids=(1, 5))
     assert losses[-1] < losses[0]
     assert np.isfinite(ev["eval_acc"])
 
 
 def test_trimmed_mean_resists_scale_attack(base_cfg, mesh8):
     cfg = base_cfg.replace(aggregator="trimmed_mean", trimmed_mean_beta=0.25)
-    _, losses, ev = _run_rounds(cfg, mesh8, n_rounds=4, attack="scale", byz_ids=(2,))
+    _, losses, ev = _run_rounds(cfg, mesh8, n_rounds=3, attack="scale", byz_ids=(2,))
     assert losses[-1] < losses[0]
     assert ev["eval_acc"] > 0.4
 
 
+# slow tier: the compiled-round median path is already inner-covered by
+# test_round_blockwise_matches_gathered[median] (an exact e2e equivalence,
+# strictly stronger than this liveness check).
+@pytest.mark.slow
 def test_median_runs(base_cfg, mesh8):
     cfg = base_cfg.replace(aggregator="median")
     _, losses, _ = _run_rounds(cfg, mesh8, n_rounds=2)

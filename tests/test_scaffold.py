@@ -191,3 +191,107 @@ def test_fused_equals_sequential(mesh8):
 def test_scaffold_rejects_dp():
     with pytest.raises(ValueError, match="pre-clip"):
         Config(**CFG, scaffold=True, dp_clip=1.0)
+
+
+_MP_BASE = dict(
+    num_peers=4, trainers_per_round=2, local_epochs=1, samples_per_peer=8,
+    batch_size=4, model="vit_tiny", dataset="cifar10", vit_depth=2,
+    compute_dtype="float32", lr=0.05, server_lr=1.0, scaffold=True,
+)
+
+
+@pytest.mark.parametrize(
+    "knobs",
+    [
+        {"tp_shards": 2, "vit_heads": 4},  # inner-loop representative
+        pytest.param(
+            {"seq_shards": 2, "vit_pool": "mean"}, marks=pytest.mark.slow
+        ),
+        pytest.param(
+            {"ep_shards": 2, "moe_experts": 4, "moe_capacity_factor": 4.0},
+            marks=pytest.mark.slow,
+        ),
+        pytest.param(
+            {"pp_shards": 2, "vit_scan_blocks": True}, marks=pytest.mark.slow
+        ),
+    ],
+    ids=["tp", "seq", "ep", "pp"],
+)
+def test_scaffold_model_parallel_matches_dense(mesh8, knobs):
+    """SCAFFOLD composes with tp/seq/ep/pp: c mirrors the params placement,
+    the c_i stack places like the optimizer state, and TWO rounds (so the
+    round-2 bias consumes round 1's control variates through the sharded
+    placement) equal the dense twin — params AND control state."""
+    from p2pdl_tpu.parallel.mesh import data_sharding, make_mesh
+
+    base = Config(**{**_MP_BASE, **knobs})
+    results = {}
+    for sharded in (False, True):
+        if sharded:
+            cfg = base
+            mesh = make_mesh(
+                8, tp_shards=cfg.tp_shards, ep_shards=cfg.ep_shards,
+                pp_shards=cfg.pp_shards, seq_shards=cfg.seq_shards,
+            )
+        else:
+            cfg = base.replace(tp_shards=1, ep_shards=1, pp_shards=1, seq_shards=1)
+            mesh = make_mesh(4)
+        data = make_federated_data(cfg, eval_samples=8)
+        state = shard_state(init_peer_state(cfg), cfg, mesh)
+        x = jax.device_put(data.x, data_sharding(mesh))
+        y = jax.device_put(data.y, peer_sharding(mesh))
+        fn = build_round_fn(cfg, mesh)
+        for r in range(2):
+            state, _ = fn(
+                state, x, y, jnp.asarray([0, 2], jnp.int32), jnp.zeros(4),
+                jax.random.PRNGKey(r),
+            )
+        results[sharded] = state
+    for field in ("params", "scaffold_c", "scaffold_ci"):
+        for a, b in zip(
+            jax.tree.leaves(getattr(results[True], field)),
+            jax.tree.leaves(getattr(results[False], field)),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-5, err_msg=field
+            )
+
+
+@pytest.mark.slow
+def test_scaffold_tp_fused_equals_sequential(mesh8):
+    """The fused multi-round path under scaffold x tp: the mp-aware extras
+    specs (c = params placement, c_i = derived stack) carry through the
+    on-device scan and R fused rounds equal R sequential rounds — params
+    and control state."""
+    from p2pdl_tpu.parallel.mesh import data_sharding, make_mesh
+
+    cfg = Config(**{**_MP_BASE, "tp_shards": 2, "vit_heads": 4})
+    mesh = make_mesh(8, tp_shards=2)
+    data = make_federated_data(cfg, eval_samples=8)
+    x = jax.device_put(data.x, data_sharding(mesh))
+    y = jax.device_put(data.y, peer_sharding(mesh))
+    byz = jnp.zeros(4)
+    base_key = jax.random.PRNGKey(cfg.seed)
+    trainer_mat = np.asarray([[0, 2], [1, 3]])
+
+    seq_state = shard_state(init_peer_state(cfg), cfg, mesh)
+    fn = build_round_fn(cfg, mesh)
+    for r in range(2):
+        seq_state, _ = fn(
+            seq_state, x, y, jnp.asarray(trainer_mat[r], jnp.int32), byz,
+            jax.random.fold_in(base_key, r),
+        )
+
+    fused_state = shard_state(init_peer_state(cfg), cfg, mesh)
+    multi_fn = build_multi_round_fn(cfg, mesh)
+    fused_state, _ = multi_fn(
+        fused_state, x, y, jnp.asarray(trainer_mat, jnp.int32), byz, base_key
+    )
+    for field in ("params", "scaffold_c", "scaffold_ci"):
+        for a, b in zip(
+            jax.tree.leaves(getattr(fused_state, field)),
+            jax.tree.leaves(getattr(seq_state, field)),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-5, err_msg=field
+            )
