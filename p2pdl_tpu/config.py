@@ -626,17 +626,15 @@ class Config:
                     "is data-dependent per coordinate and the clip/noise "
                     "calibration does not cover it"
                 )
-            # Sequence parallelism composes (deltas are replicated across
-            # the seq axis, so the global top-k selection is unchanged and
-            # the residual stack stays peer-placed).
-            if self.tp_shards > 1 or self.ep_shards > 1 or self.pp_shards > 1:
-                raise ValueError(
-                    "compress with tensor/expert/pipeline parallelism is not "
-                    "yet supported: the top-k threshold is GLOBAL over the "
-                    "full flattened update, but each shard holds only a "
-                    "slice — a per-shard selection would misallocate the "
-                    "budget (needs a cross-shard distributed top-k)"
-                )
+            # Model/sequence parallelism composes. seq: deltas are
+            # replicated across the seq axis, so the local selection is
+            # already global. tp/ep/pp: the top-k threshold is GLOBAL over
+            # the full flattened update while each shard holds a slice, so
+            # the per-peer k-th magnitude comes from a distributed
+            # bit-bisection (count psums over the model axis,
+            # ops/compression.kth_magnitude_sharded) — selection, shipping,
+            # and the EF residual then stay shard-local; the residual stack
+            # places like the optimizer state.
         if self.scaffold:
             if self.aggregator != "fedavg":
                 raise ValueError(

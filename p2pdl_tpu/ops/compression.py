@@ -21,6 +21,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 
 def _flat(tree: Any, l_per_dev: int) -> jnp.ndarray:
@@ -71,3 +72,108 @@ def topk_ef(delta: Any, err: Any, ratio: float) -> tuple[Any, Any]:
     )
     new_err = v - _flat(sent_tree, l_per_dev)
     return sent_tree, _unflat(new_err, err)
+
+
+def kth_magnitude_sharded(
+    mags_sh: jnp.ndarray,
+    mags_rep: jnp.ndarray,
+    k: int,
+    axis: str,
+) -> jnp.ndarray:
+    """Per-peer k-th largest magnitude of a MODEL-AXIS-DISTRIBUTED vector
+    — the global top-k threshold each shard needs without ever gathering
+    the vector. 32 steps of bisection on the float32 BIT space
+    (non-negative float32 values order exactly like their uint32 bit
+    patterns), each step one [L]-wise local count plus one psum over
+    ``axis`` — O(1) communication per step, and after 32 halvings of the
+    2^32-wide interval the threshold is the EXACT k-th-largest value, so
+    the ``|v| >= kth`` tie-inclusive mask matches the gathered
+    ``lax.top_k`` selection bit-for-bit.
+
+    ``mags_sh``: ``[L, D_sh]`` this shard's slice of the sharded leaves'
+    magnitudes; ``mags_rep``: ``[L, D_rep]`` the replicated leaves'
+    magnitudes (counted ONCE, outside the psum — every shard holds the
+    same full copy and a blind psum would multiply them shards-fold).
+    """
+    def count_ge(t):  # t: [L] -> per-peer global count of |v| >= t
+        c_sh = jnp.sum((mags_sh >= t[:, None]).astype(jnp.int32), axis=1)
+        c_rep = jnp.sum((mags_rep >= t[:, None]).astype(jnp.int32), axis=1)
+        return lax.psum(c_sh, axis) + c_rep
+
+    def step(_, bounds):
+        lo, hi = bounds  # invariant: count(float(lo)) >= k > count(float(hi))
+        mid = (lo + hi) // jnp.uint32(2)
+        ok = count_ge(lax.bitcast_convert_type(mid, jnp.float32)) >= k
+        return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid)
+
+    # Bounds derived FROM the inputs (not fresh constants) so the loop
+    # carry inherits the COUNTS' varying-manual-axes type under shard_map:
+    # peer-varying whenever the magnitudes are (each peer bisects its own
+    # threshold), but mp-INVARIANT — the sharded contribution flows
+    # through the same psum the counts use, because the threshold must be
+    # identical on every model shard (replicated leaves' selections stay
+    # replicated).
+    # Multiply by 0 ELEMENTWISE before summing: summing first could
+    # overflow to inf and 0*inf = NaN would corrupt the bounds.
+    zero = lax.bitcast_convert_type(
+        lax.psum(jnp.sum(mags_sh * 0.0, axis=1), axis)
+        + jnp.sum(mags_rep * 0.0, axis=1),
+        jnp.uint32,
+    )  # [L] +0.0 bits: count >= k always (k <= D)
+    hi0 = zero + jnp.uint32(0x7F800001)  # > +inf: count 0 < k
+    kth_bits, _ = lax.fori_loop(0, 32, step, (zero, hi0))
+    # A threshold that lands in the DENORMAL range clamps to +0.0: XLA
+    # backends flush denormals in the compare, so every denormal behaves
+    # as 0.0 there anyway — the clamp makes the returned value bit-equal
+    # to the gathered lax.top_k result (whose k-th value is then 0.0).
+    kth_bits = jnp.where(kth_bits < jnp.uint32(0x00800000), zero, kth_bits)
+    return lax.bitcast_convert_type(kth_bits, jnp.float32)
+
+
+def topk_ef_sharded(
+    delta: Any,
+    err: Any,
+    ratio: float,
+    axis: str,
+    sharded: Any,
+    n_shards: int,
+) -> tuple[Any, Any]:
+    """:func:`topk_ef` for a model-parallel layout (tp/ep/pp): each device
+    holds SLICES of the sharded leaves, so the global per-peer top-k
+    threshold comes from :func:`kth_magnitude_sharded` instead of a local
+    sort — selection, shipping, and the EF residual then stay per-leaf
+    local. ``sharded``: per-leaf bool tree (which leaves are split over
+    ``axis``); ``n_shards``: static shard count (slice sizes are equal —
+    the mesh requires divisibility — so the global dimension is
+    ``n_shards * D_sh_local + D_rep``, computed statically)."""
+    leaves = jax.tree.leaves(delta)
+    l_per_dev = leaves[0].shape[0]
+    flags = jax.tree.leaves(sharded)
+    v = jax.tree.map(
+        lambda d, e: d.astype(jnp.float32) + e.astype(jnp.float32), delta, err
+    )
+    v_leaves = jax.tree.leaves(v)
+
+    def cat(rows):
+        if not rows:
+            return jnp.zeros((l_per_dev, 0), jnp.float32)
+        return jnp.concatenate([r.reshape(l_per_dev, -1) for r in rows], axis=1)
+
+    mags_sh = jnp.abs(cat([x for x, s in zip(v_leaves, flags) if s]))
+    mags_rep = jnp.abs(cat([x for x, s in zip(v_leaves, flags) if not s]))
+    d_total = n_shards * mags_sh.shape[1] + mags_rep.shape[1]
+    k = max(1, int(np.ceil(ratio * d_total)))
+    if k >= d_total:
+        sent = jax.tree.map(lambda x, d: x.astype(d.dtype), v, delta)
+    else:
+        kth = kth_magnitude_sharded(mags_sh, mags_rep, k, axis)  # [L]
+
+        def select(x, d):
+            t = kth.reshape((l_per_dev,) + (1,) * (x.ndim - 1))
+            return jnp.where(jnp.abs(x) >= t, x, 0.0).astype(d.dtype)
+
+        sent = jax.tree.map(select, v, delta)
+    new_err = jax.tree.map(
+        lambda vv, s: vv - s.astype(jnp.float32), v, sent
+    )
+    return sent, new_err

@@ -515,8 +515,8 @@ def build_round_fn(
     # sharded-leaf classification.
     mp_kind = "tp" if tp_axis else ("ep" if ep_axis else ("pp" if pp_axis else None))
     mp_specs = _model_parallel_specs(cfg, mp_kind) if mp_kind else None
-    dp_axis = (tp_axis or ep_axis or pp_axis) if cfg.dp_clip > 0.0 else None
-    dp_sharded = _dp_sharded_tree(mp_specs[0], dp_axis) if dp_axis else None
+    mp_axis = tp_axis or ep_axis or pp_axis
+    mp_sharded = _dp_sharded_tree(mp_specs[0], mp_axis) if mp_axis else None
     emit_delta = False
     if params_layout(cfg) == "peer":
         emit_delta = cfg.brb_enabled
@@ -533,7 +533,7 @@ def build_round_fn(
         body = _general_sync_body(
             cfg, attack, model, opt, l_per_dev,
             seq_axis=seq_axis, ep_axis=ep_axis, pair_seeds=pair_seeds,
-            dp_axis=dp_axis, dp_sharded=dp_sharded,
+            mp_axis=mp_axis, mp_sharded=mp_sharded,
         )
         params_spec = P()
     sp = P(PEER_AXIS)
@@ -695,8 +695,8 @@ def build_multi_round_fn(
     # sharded-leaf classification (same structure as build_round_fn).
     mp_kind = "tp" if tp_axis else ("ep" if ep_axis else ("pp" if pp_axis else None))
     mp_specs = _model_parallel_specs(cfg, mp_kind) if mp_kind else None
-    dp_axis = (tp_axis or ep_axis or pp_axis) if cfg.dp_clip > 0.0 else None
-    dp_sharded = _dp_sharded_tree(mp_specs[0], dp_axis) if dp_axis else None
+    mp_axis = tp_axis or ep_axis or pp_axis
+    mp_sharded = _dp_sharded_tree(mp_specs[0], mp_axis) if mp_axis else None
     if params_layout(cfg) == "peer":
         body = _gossip_body(cfg, mesh, attack, model, opt, l_per_dev, emit_delta=False)
         params_spec = P(PEER_AXIS)
@@ -710,7 +710,7 @@ def build_multi_round_fn(
         body = _general_sync_body(
             cfg, attack, model, opt, l_per_dev,
             seq_axis=seq_axis, ep_axis=ep_axis, pair_seeds=pair_seeds,
-            dp_axis=dp_axis, dp_sharded=dp_sharded,
+            mp_axis=mp_axis, mp_sharded=mp_sharded,
         )
         params_spec = P()
     sp = P(PEER_AXIS)
@@ -1567,19 +1567,23 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
 
 def _general_sync_body(
     cfg, attack, model, opt, l_per_dev, seq_axis=None, ep_axis=None,
-    pair_seeds=None, dp_axis=None, dp_sharded=None,
+    pair_seeds=None, mp_axis=None, mp_sharded=None,
 ):
     """Role-based round over single-copy global params: broadcast the global
     model into a vmapped local-SGD phase (peers diverge only transiently),
     aggregate trainer deltas, apply one deterministic server update. One
-    fused program = the two phase fragments composed with no host boundary."""
+    fused program = the two phase fragments composed with no host boundary.
+
+    ``mp_axis``/``mp_sharded``: the model-parallel mesh axis + per-leaf
+    split-or-replicated bool tree, consumed by the cross-shard DP clip
+    norm/noise and the distributed top-k compression threshold."""
     train = _local_train_phase(
         cfg, attack, model, opt, l_per_dev,
         seq_axis=seq_axis, ep_axis=ep_axis, with_bias=cfg.scaffold,
     )
     agg = _aggregate_phase(
         cfg, l_per_dev, pair_seeds=pair_seeds,
-        dp_axis=dp_axis, dp_sharded=dp_sharded,
+        dp_axis=mp_axis if cfg.dp_clip > 0.0 else None, dp_sharded=mp_sharded,
     )
 
     if cfg.compress != "none":
@@ -1589,7 +1593,13 @@ def _general_sync_body(
         # deltas are discarded whole, so their unsent mass must not
         # accumulate); the attack epilogue ran inside the train phase, so
         # an attacker ships the sparsified form of its corrupted update.
-        from p2pdl_tpu.ops.compression import topk_ef
+        # Under tp/ep/pp the per-peer threshold is the DISTRIBUTED k-th
+        # magnitude (bit-bisection + count psums, ops/compression
+        # kth_magnitude_sharded) — each shard then selects/ships/updates
+        # its residual locally.
+        from p2pdl_tpu.ops.compression import topk_ef, topk_ef_sharded
+
+        n_mp_shards = max(cfg.tp_shards, cfg.ep_shards, cfg.pp_shards)
 
         def body(params, opt_state, err, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
             dev = lax.axis_index(PEER_AXIS)
@@ -1601,7 +1611,13 @@ def _general_sync_body(
             # topk_ef ships each leaf in the delta dtype and computes the
             # residual against the cast value, so the quantization error of
             # a low-precision param_dtype stays inside the EF telescoping.
-            sent, new_err = topk_ef(delta, err, cfg.compress_ratio)
+            if mp_axis is not None:
+                sent, new_err = topk_ef_sharded(
+                    delta, err, cfg.compress_ratio, mp_axis, mp_sharded,
+                    n_mp_shards,
+                )
+            else:
+                sent, new_err = topk_ef(delta, err, cfg.compress_ratio)
 
             def keep_trainers(n, o):
                 m = is_trainer.reshape((l_per_dev,) + (1,) * (n.ndim - 1))

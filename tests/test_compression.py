@@ -56,6 +56,36 @@ def test_topk_ef_unit():
     )
 
 
+def test_kth_magnitude_sharded_matches_topk(mesh8):
+    """The distributed bit-bisection threshold equals the gathered
+    lax.top_k k-th value EXACTLY (the mask semantics depend on it), for
+    sharded-only, replicated-only, and mixed splits — including ties and
+    zero-heavy rows."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from p2pdl_tpu.ops.compression import kth_magnitude_sharded
+
+    rng = np.random.default_rng(5)
+    l, d_sh, d_rep = 3, 64, 24
+    mags_sh = np.abs(rng.normal(size=(l, 2 * d_sh)).astype(np.float32))
+    mags_rep = np.abs(rng.normal(size=(l, d_rep)).astype(np.float32))
+    mags_sh[0, :50] = 0.0  # zero-heavy row
+    mags_sh[1, 3] = mags_sh[1, 7] = mags_rep[1, 2]  # exact ties
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("mp",))
+    for k in (1, 5, 40, 100, 2 * d_sh + d_rep):
+        got = jax.jit(
+            jax.shard_map(
+                lambda s, r: kth_magnitude_sharded(s, r, k, "mp"),
+                mesh=mesh,
+                in_specs=(P(None, "mp"), P()),
+                out_specs=P(),
+            )
+        )(jnp.asarray(mags_sh), jnp.asarray(mags_rep))
+        full = np.concatenate([mags_sh, mags_rep], axis=1)
+        want = np.sort(full, axis=1)[:, -k]
+        np.testing.assert_array_equal(np.asarray(got), want, err_msg=f"k={k}")
+
+
 def test_ratio_one_is_identity(mesh8):
     """ratio=1 ships everything: params bit-match the uncompressed round
     and the residual stays zero."""
@@ -178,30 +208,57 @@ def test_fused_equals_sequential(mesh8):
             )
 
 
-@pytest.mark.slow
-def test_compression_seq_parallel_matches_dense(mesh8):
-    """EF top-k composes with sequence parallelism: deltas are replicated
-    across the seq axis, so the global top-k selection and the residual
-    telescoping are unchanged — the (peers x seq) round equals the dense
-    twin, params and residuals. Almost: the seq grads psum in a different
-    reduction order, and top-k is DISCONTINUOUS at the k-th-magnitude
-    boundary, so a float-level delta difference can flip an at-threshold
-    coordinate's selection. The assertion bounds that honestly: ~all
-    coordinates tight, at most a vanishing fraction flipped, and any
-    flipped coordinate off by no more than its own (near-threshold, hence
-    small) shipped magnitude."""
+@pytest.mark.parametrize(
+    "knobs",
+    [
+        # tp is the inner-loop representative: it exercises the
+        # distributed bit-bisection threshold (kth_magnitude_sharded).
+        {"tp_shards": 2, "vit_heads": 4},
+        pytest.param(
+            {"seq_shards": 2, "vit_pool": "mean"}, marks=pytest.mark.slow
+        ),
+        pytest.param(
+            {"ep_shards": 2, "moe_experts": 4, "moe_capacity_factor": 4.0},
+            marks=pytest.mark.slow,
+        ),
+        pytest.param(
+            {"pp_shards": 2, "vit_scan_blocks": True}, marks=pytest.mark.slow
+        ),
+    ],
+    ids=["tp", "seq", "ep", "pp"],
+)
+def test_compression_model_parallel_matches_dense(mesh8, knobs):
+    """EF top-k composes with tp/seq/ep/pp: under seq the deltas are
+    replicated so the local selection is already global; under tp/ep/pp
+    the per-peer threshold is the DISTRIBUTED k-th magnitude and each
+    shard selects/ships/updates its residual slice locally. TWO rounds
+    (round 2 consumes round 1's residual through the sharded placement)
+    equal the dense twin — almost: grads psum in a different reduction
+    order across layouts, and top-k is DISCONTINUOUS at the
+    k-th-magnitude boundary, so a float-level delta difference can flip
+    an at-threshold coordinate's selection. The assertion bounds that
+    honestly: ~all coordinates tight, at most a vanishing fraction
+    flipped, and any flipped coordinate off by no more than its own
+    (near-threshold, hence small) shipped magnitude."""
     from p2pdl_tpu.parallel.mesh import data_sharding, make_mesh
 
     base = Config(
         num_peers=4, trainers_per_round=2, local_epochs=1, samples_per_peer=8,
         batch_size=4, model="vit_tiny", dataset="cifar10", vit_depth=2,
-        vit_pool="mean", compute_dtype="float32", lr=0.05, server_lr=1.0,
-        compress="topk", compress_ratio=0.2, seq_shards=2,
+        compute_dtype="float32", lr=0.05, server_lr=1.0,
+        compress="topk", compress_ratio=0.2, **knobs,
     )
     results = {}
     for sharded in (False, True):
-        cfg = base if sharded else base.replace(seq_shards=1)
-        mesh = make_mesh(8, seq_shards=2) if sharded else make_mesh(4)
+        if sharded:
+            cfg = base
+            mesh = make_mesh(
+                8, tp_shards=cfg.tp_shards, ep_shards=cfg.ep_shards,
+                pp_shards=cfg.pp_shards, seq_shards=cfg.seq_shards,
+            )
+        else:
+            cfg = base.replace(tp_shards=1, ep_shards=1, pp_shards=1, seq_shards=1)
+            mesh = make_mesh(4)
         data = make_federated_data(cfg, eval_samples=8)
         state = shard_state(init_peer_state(cfg), cfg, mesh)
         x = jax.device_put(data.x, data_sharding(mesh))
@@ -249,3 +306,47 @@ def test_compression_composes_with_robust_aggregation(mesh8):
         jnp.mean(build_eval_fn(cfg)(state, data.eval_x, data.eval_y)["eval_acc"])
     )
     assert acc > 0.85, acc
+
+
+@pytest.mark.slow
+def test_compression_tp_fused_equals_sequential(mesh8):
+    """The fused multi-round path under compress x tp: the mp-aware
+    residual spec rides the on-device scan carry and R fused rounds equal
+    R sequential rounds — params and residuals."""
+    from p2pdl_tpu.parallel.mesh import data_sharding, make_mesh
+
+    cfg = Config(
+        num_peers=4, trainers_per_round=2, local_epochs=1, samples_per_peer=8,
+        batch_size=4, model="vit_tiny", dataset="cifar10", vit_depth=2,
+        vit_heads=4, tp_shards=2, compute_dtype="float32", lr=0.05,
+        server_lr=1.0, compress="topk", compress_ratio=0.2,
+    )
+    mesh = make_mesh(8, tp_shards=2)
+    data = make_federated_data(cfg, eval_samples=8)
+    x = jax.device_put(data.x, data_sharding(mesh))
+    y = jax.device_put(data.y, peer_sharding(mesh))
+    byz = jnp.zeros(4)
+    base_key = jax.random.PRNGKey(cfg.seed)
+    trainer_mat = np.asarray([[0, 2], [1, 3]])
+
+    seq_state = shard_state(init_peer_state(cfg), cfg, mesh)
+    fn = build_round_fn(cfg, mesh)
+    for r in range(2):
+        seq_state, _ = fn(
+            seq_state, x, y, jnp.asarray(trainer_mat[r], jnp.int32), byz,
+            jax.random.fold_in(base_key, r),
+        )
+
+    fused_state = shard_state(init_peer_state(cfg), cfg, mesh)
+    multi_fn = build_multi_round_fn(cfg, mesh)
+    fused_state, _ = multi_fn(
+        fused_state, x, y, jnp.asarray(trainer_mat, jnp.int32), byz, base_key
+    )
+    for field in ("params", "compress_err"):
+        for a, b in zip(
+            jax.tree.leaves(getattr(fused_state, field)),
+            jax.tree.leaves(getattr(seq_state, field)),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, err_msg=field
+            )
