@@ -328,8 +328,8 @@ class Config:
                 raise ValueError(f"server_eps must be > 0, got {self.server_eps}")
         # One guard set for EVERY stateful server optimizer (FedAvgM buffer
         # or FedOpt m/v): the reconstruction divides by server_lr, gossip
-        # has no server, and the gated trust round applies its server
-        # update in the second program.
+        # has no server, and low-precision params would quantize the
+        # reconstructed pseudo-gradient.
         if self.server_momentum > 0.0 or self.server_opt != "sgd":
             knob = (
                 "server_momentum"
@@ -346,12 +346,10 @@ class Config:
                     f"{knob} requires a server update; gossip is "
                     f"decentralized (no server) — use a sync-layout aggregator"
                 )
-            if self.brb_enabled:
-                raise ValueError(
-                    f"{knob} with the BRB trust plane is not yet supported "
-                    f"(the gated two-program round applies its server update "
-                    f"in the second program)"
-                )
+            # The BRB trust plane composes: the gated two-program round's
+            # aggregate phase applies the same FedAvgM/FedOpt helpers to
+            # the verdict-admitted aggregate (parallel/round agg_fn), so
+            # the server buffers accumulate exactly what the gate let in.
             if self.param_dtype != "float32":
                 raise ValueError(
                     f"{knob} requires param_dtype='float32': the server "

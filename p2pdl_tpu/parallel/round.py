@@ -911,11 +911,27 @@ def build_trust_round_fns(
             state.params, state.opt_state, new_opt, delta, trainer_idx,
             masked_idx, mask_key, state.round_idx, *extra,
         )
+        # Stateful server optimizers compose with the trust plane: the
+        # FedAvgM/FedOpt step applies to the GATED aggregate (what the
+        # verdict admitted), reconstructed from (p' - p)/server_lr on the
+        # replicated arrays — identical helpers to the fused round, so
+        # all-verify gated rounds match it exactly (tested).
+        server_m, server_v = state.server_m, state.server_v
+        if cfg.server_opt in ("adam", "yogi"):
+            new_params, server_m, server_v = _apply_server_opt(
+                cfg, state.params, new_params, server_m, server_v
+            )
+        elif cfg.server_momentum > 0.0:
+            new_params, server_m = _apply_server_momentum(
+                cfg, state.params, new_params, server_m
+            )
         return PeerState(
             params=new_params,
             opt_state=kept_opt,
             rng=state.rng,
             round_idx=state.round_idx + 1,
+            server_m=server_m,
+            server_v=server_v,
         )
 
     # agg_fn consumes the round's transients (deltas + trained opt state) and

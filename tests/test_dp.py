@@ -200,21 +200,29 @@ def _mp_round(cfg, n_devices, key=0, **mesh_kw):
         pytest.param(
             {"pp_shards": 2, "vit_scan_blocks": True}, marks=pytest.mark.slow
         ),
+        # seq: deltas replicate across the axis, so the clip norm needs no
+        # cross-shard psum — the composition must still equal the twin.
+        pytest.param(
+            {"seq_shards": 2, "vit_pool": "mean"}, marks=pytest.mark.slow
+        ),
     ],
-    ids=["tp", "ep", "pp"],
+    ids=["tp", "ep", "pp", "seq"],
 )
 def test_dp_clip_model_parallel_matches_dense(mesh8, knobs):
-    """DP clipping composes with tp/ep/pp: the aggregate phase completes
-    each peer's L2 norm over the model axis (psum of sharded leaves'
-    partials, replicated leaves once), so a BINDING clip produces the
-    identical round as the dense twin — sensitivity is exactly C."""
+    """DP clipping composes with tp/ep/pp/seq: the aggregate phase
+    completes each peer's L2 norm over the model axis (psum of sharded
+    leaves' partials, replicated leaves once; seq deltas are already
+    replicated), so a BINDING clip produces the identical round as the
+    dense twin — sensitivity is exactly C."""
     base = Config(**{**_MP_BASE, **knobs}, dp_clip=1e-3)
     sharded = _mp_round(
         base, 8,
         tp_shards=base.tp_shards, ep_shards=base.ep_shards,
-        pp_shards=base.pp_shards,
+        pp_shards=base.pp_shards, seq_shards=base.seq_shards,
     )
-    dense = _mp_round(base.replace(tp_shards=1, ep_shards=1, pp_shards=1), 4)
+    dense = _mp_round(
+        base.replace(tp_shards=1, ep_shards=1, pp_shards=1, seq_shards=1), 4
+    )
     for (pa, a), (pb, b) in zip(
         jax.tree_util.tree_leaves_with_path(sharded.params),
         jax.tree_util.tree_leaves_with_path(dense.params),
@@ -289,3 +297,38 @@ def test_fixed_denominator_under_vacancy(mesh8):
     ]
     for d, l in zip(dp_agg, live_agg):
         np.testing.assert_allclose(d, l * 0.5, atol=1e-6)
+
+
+def test_dp_fused_equals_sequential(mesh8):
+    """DP rounds (binding clip + noise) under fused multi-round execution:
+    the per-round noise key schedule is fold_in(base, round) in both
+    modes, so R fused rounds equal R sequential rounds bit-for-bit."""
+    from p2pdl_tpu.parallel import build_multi_round_fn, build_round_fn
+
+    cfg = Config(
+        **{**CFG, "trainers_per_round": 4}, dp_clip=1e-2, dp_noise_multiplier=1.0
+    )
+    data = make_federated_data(cfg, eval_samples=16)
+    sh = peer_sharding(mesh8)
+    x = jax.device_put(data.x, sh)
+    y = jax.device_put(data.y, sh)
+    byz = jnp.zeros(8)
+    base_key = jax.random.PRNGKey(cfg.seed)
+    trainer_mat = np.stack(
+        [np.sort(np.random.default_rng(r).choice(8, 4, replace=False)) for r in range(3)]
+    )
+    seq_state = shard_state(init_peer_state(cfg), cfg, mesh8)
+    fn = build_round_fn(cfg, mesh8)
+    for r in range(3):
+        seq_state, _ = fn(
+            seq_state, x, y, jnp.asarray(trainer_mat[r], jnp.int32), byz,
+            jax.random.fold_in(base_key, r),
+        )
+    fused_state = shard_state(init_peer_state(cfg), cfg, mesh8)
+    fused_state, _ = build_multi_round_fn(cfg, mesh8)(
+        fused_state, x, y, jnp.asarray(trainer_mat, jnp.int32), byz, base_key
+    )
+    for a, b in zip(
+        jax.tree.leaves(fused_state.params), jax.tree.leaves(seq_state.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

@@ -134,3 +134,30 @@ def test_validation():
             num_peers=8, trainers_per_round=8, model="mlp", dataset="mnist",
             aggregator="gossip", server_opt="adam",
         )
+
+
+def test_brb_gated_fedadam_matches_plain(mesh8):
+    """FedAdam under the BRB trust plane: with every broadcast delivering,
+    two gated rounds equal two plain rounds — params AND the m/v buffers
+    (the adaptive step consumes the verdict-admitted aggregate)."""
+    from p2pdl_tpu.runtime.driver import Experiment
+
+    cfg = Config(**{**CFG, "trainers_per_round": 3}, server_opt="adam")
+    trainers = np.asarray([1, 3, 6])
+    gated = Experiment(cfg.replace(brb_enabled=True, byzantine_f=2))
+    plain = Experiment(cfg)
+    for _ in range(2):
+        gated.run_round(trainers=trainers)
+        plain.run_round(trainers=trainers)
+    # atol 1e-5, not 1e-6: the two paths reconstruct (p'-p)/server_lr in
+    # differently-fused programs, and adam's 1/(sqrt(v)+eps) amplifies the
+    # ~1-ulp reconstruction difference (same stance as the cross-layout
+    # adam tolerance in test_momentum_model_parallel).
+    for field in ("params", "server_m", "server_v"):
+        for a, b in zip(
+            jax.tree.leaves(getattr(gated.state, field)),
+            jax.tree.leaves(getattr(plain.state, field)),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, err_msg=field
+            )

@@ -93,6 +93,43 @@ def test_fused_equals_sequential(mesh8, aggregator, peer_chunk, num_peers, gossi
     assert int(fused_state.round_idx) == rounds
 
 
+def test_fused_equals_sequential_krum(mesh8):
+    """A gathered robust reducer (multi-Krum, f=1) inside the fused scan:
+    the full [T] update matrix and the selection run per scan step and R
+    fused rounds equal R sequential rounds."""
+    cfg = CFG.replace(
+        aggregator="multi_krum", byzantine_f=1, trainers_per_round=5,
+    )
+    data = make_federated_data(cfg, eval_samples=16)
+    sh = peer_sharding(mesh8)
+    x = jax.device_put(data.x, sh)
+    y = jax.device_put(data.y, sh)
+    byz = jnp.zeros(8)
+    base_key = jax.random.PRNGKey(cfg.seed)
+    rounds = 3
+    trainer_mat = np.stack(
+        [
+            np.sort(np.random.default_rng(r).choice(8, 5, replace=False))
+            for r in range(rounds)
+        ]
+    )
+    seq_state = shard_state(init_peer_state(cfg), cfg, mesh8)
+    round_fn = build_round_fn(cfg, mesh8)
+    for r in range(rounds):
+        seq_state, _ = round_fn(
+            seq_state, x, y, jnp.asarray(trainer_mat[r], jnp.int32), byz,
+            jax.random.fold_in(base_key, r),
+        )
+    fused_state = shard_state(init_peer_state(cfg), cfg, mesh8)
+    fused_state, _ = build_multi_round_fn(cfg, mesh8)(
+        fused_state, x, y, jnp.asarray(trainer_mat, jnp.int32), byz, base_key
+    )
+    for a, b in zip(
+        jax.tree.leaves(fused_state.params), jax.tree.leaves(seq_state.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_run_fused_driver_matches_run(mesh8, tmp_path):
     seq = Experiment(CFG, log_path=str(tmp_path / "seq.jsonl"))
     seq_records = seq.run()

@@ -53,8 +53,16 @@ _BASE = dict(
         # Adam: count/mu/nu state through the per-leaf placement (mu/nu
         # mirror the params; the stacked count falls back to P(peers)).
         {"tp_shards": 2, "vit_heads": 4, "optimizer": "adam", "momentum": 0.0},
+        # FedAvgM server buffer on top of the worker trace: server_m
+        # mirrors the params placement and the outside-the-body helper
+        # runs on the sharded arrays (GSPMD), so two rounds still equal
+        # the dense twin exactly.
+        pytest.param(
+            {"tp_shards": 2, "vit_heads": 4, "server_momentum": 0.9},
+            marks=pytest.mark.slow,
+        ),
     ],
-    ids=["tp", "ep", "pp", "tp-adam"],
+    ids=["tp", "ep", "pp", "tp-adam", "tp-fedavgm"],
 )
 def test_momentum_rounds_match_dense(mesh8, knobs):
     base = Config(**{**_BASE, **knobs})

@@ -257,3 +257,42 @@ def test_reconstructed_seeds_cancel_orphans():
     raw_sum = np.asarray(masked)[surv].sum(0)
     honest = np.asarray(deltas)[surv].sum(0)
     np.testing.assert_allclose(raw_sum - np.asarray(resid), honest, rtol=1e-4, atol=1e-4)
+
+
+def test_secure_masks_cancel_under_tensor_parallel(mesh8):
+    """secure_fedavg composes with tp: masks draw per LOCAL slice with the
+    symmetric pair key, so both endpoints of every pair generate identical
+    slice masks and the sum cancels WITHIN each shard — the masked
+    (peers x tp) round equals the unmasked fedavg round on the same mesh."""
+    from p2pdl_tpu.config import Config
+    from p2pdl_tpu.data import make_federated_data
+    from p2pdl_tpu.parallel import (
+        build_round_fn, init_peer_state, peer_sharding, shard_state,
+    )
+    from p2pdl_tpu.parallel.mesh import data_sharding, make_mesh
+
+    base = dict(
+        num_peers=4, trainers_per_round=2, local_epochs=1, samples_per_peer=8,
+        batch_size=4, model="vit_tiny", dataset="cifar10", vit_depth=2,
+        vit_heads=4, tp_shards=2, compute_dtype="float32", lr=0.05,
+        server_lr=1.0,
+    )
+    mesh = make_mesh(8, tp_shards=2)
+    results = {}
+    for aggregator in ("fedavg", "secure_fedavg"):
+        cfg = Config(**base, aggregator=aggregator)
+        data = make_federated_data(cfg, eval_samples=8)
+        state = shard_state(init_peer_state(cfg), cfg, mesh)
+        x = jax.device_put(data.x, data_sharding(mesh))
+        y = jax.device_put(data.y, peer_sharding(mesh))
+        fn = build_round_fn(cfg, mesh)
+        state, _ = fn(
+            state, x, y, jnp.asarray([0, 2], jnp.int32), jnp.zeros(4),
+            jax.random.PRNGKey(0),
+        )
+        results[aggregator] = state
+    for a, b in zip(
+        jax.tree.leaves(results["secure_fedavg"].params),
+        jax.tree.leaves(results["fedavg"].params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)

@@ -151,8 +151,40 @@ def test_validation():
             num_peers=8, trainers_per_round=8, model="mlp", dataset="mnist",
             aggregator="gossip", server_momentum=0.9,
         )
-    with pytest.raises(ValueError, match="BRB"):
-        Config(**CFG, server_momentum=0.9, brb_enabled=True)
+    # server_momentum with the BRB trust plane is now supported (the gated
+    # aggregate phase applies the same helper; equivalence tested below).
+    Config(**CFG, server_momentum=0.9, brb_enabled=True)
+
+
+def test_brb_gated_momentum_matches_fused_when_all_verify(mesh8):
+    """Gated (BRB) rounds with FedAvgM: with every broadcast delivering,
+    two gated rounds equal two fused rounds — params AND the momentum
+    buffer (the buffer accumulates the admitted aggregate, here all of
+    it). With a gated-out trainer, the buffer accumulates only what the
+    verdict admitted (vacancy-equivalence, second block)."""
+    from p2pdl_tpu.runtime.driver import Experiment
+
+    cfg = Config(**{**CFG, "trainers_per_round": 3}, server_momentum=0.9)
+    trainers = np.asarray([1, 3, 6])
+    gated = Experiment(cfg.replace(brb_enabled=True, byzantine_f=2))
+    plain = Experiment(cfg)
+    for _ in range(2):
+        gated.run_round(trainers=trainers)
+        plain.run_round(trainers=trainers)
+    _assert_params_close(gated.state.params, plain.state.params, atol=1e-6)
+    _assert_params_close(gated.state.server_m, plain.state.server_m, atol=1e-6)
+
+    # Equivocator gated out in-round == fused round with a -1 vacancy.
+    victim = 3
+    byz = Experiment(
+        cfg.replace(brb_enabled=True, byzantine_f=2), byz_ids=(victim,)
+    )
+    rec = byz.run_round(trainers=trainers)
+    assert rec.brb_excluded_trainers == [victim]
+    vac = Experiment(cfg)
+    vac.run_round(trainers=np.asarray([1, -1, 6]))
+    _assert_params_close(byz.state.params, vac.state.params, atol=1e-6)
+    _assert_params_close(byz.state.server_m, vac.state.server_m, atol=1e-6)
 
 
 def test_fused_model_parallel_with_momentum_off(mesh8):
