@@ -604,11 +604,11 @@ class Config:
                     "compress applies to shipped trainer deltas; gossip "
                     "mixes params, not deltas"
                 )
-            if self.peer_chunk > 0:
-                raise ValueError(
-                    "compress with peer_chunk is not supported (the per-peer "
-                    "error-feedback residual needs per-peer deltas)"
-                )
+            # peer_chunk composes: the residual chunks stream through the
+            # scan with the data, each chunk sparsifies its peers' deltas
+            # in place, and the refreshed slices come back as stacked scan
+            # outputs — chunked == general (tested). Adaptive attacks are
+            # rejected at build time (their envelope lands post-scan).
             if self.brb_enabled:
                 raise ValueError(
                     "compress with the BRB trust plane is not yet supported"
@@ -652,11 +652,10 @@ class Config:
                     "components instead of the average gradient the "
                     "correction assumes"
                 )
-            if self.peer_chunk > 0:
-                raise ValueError(
-                    "scaffold with peer_chunk is not supported (per-peer "
-                    "control variates need per-peer deltas)"
-                )
+            # peer_chunk composes: c_i chunks stream through the scan (the
+            # bias enters each chunk's local steps), the server-c numerator
+            # accumulates across chunks, and the refreshed c_i slices come
+            # back as stacked scan outputs — chunked == general (tested).
             if self.brb_enabled:
                 raise ValueError(
                     "scaffold with the BRB trust plane is not yet supported"

@@ -396,6 +396,19 @@ def _resolve_pair_seeds(cfg: Config, pair_seeds):
     return pair_seeds
 
 
+def _apply_server_update(cfg: Config, old_params, new_params, m, v):
+    """ONE dispatch for the stateful server-optimizer step — shared by the
+    sequential round, the fused scan body, and the BRB-gated agg_fn, so
+    the three paths cannot drift (their mutual equivalence is
+    test-asserted). Returns ``(params, m, v)`` unchanged when no stateful
+    server optimizer is configured."""
+    if cfg.server_opt in ("adam", "yogi"):
+        return _apply_server_opt(cfg, old_params, new_params, m, v)
+    if cfg.server_momentum > 0.0:
+        new_params, m = _apply_server_momentum(cfg, old_params, new_params, m)
+    return new_params, m, v
+
+
 def _apply_server_momentum(cfg: Config, old_params, new_params, m):
     """FedAvgM (Hsu et al. 2019) applied OUTSIDE the shard-mapped body.
 
@@ -632,15 +645,9 @@ def build_round_fn(
         metrics = {"train_loss": losses}
         if emit_delta:
             metrics["delta"] = out[3]
-        server_m, server_v = state.server_m, state.server_v
-        if cfg.server_opt in ("adam", "yogi"):
-            new_params, server_m, server_v = _apply_server_opt(
-                cfg, state.params, new_params, server_m, server_v
-            )
-        elif cfg.server_momentum > 0.0:
-            new_params, server_m = _apply_server_momentum(
-                cfg, state.params, new_params, server_m
-            )
+        new_params, server_m, server_v = _apply_server_update(
+            cfg, state.params, new_params, state.server_m, state.server_v
+        )
         new_state = PeerState(
             params=new_params,
             opt_state=new_opt,
@@ -735,15 +742,12 @@ def build_multi_round_fn(
             # SCAFFOLD: (c, ci); compression: (err,) — the bodies emit the
             # updated state after the losses, in the same order they take it.
             extras = tuple(outs[3:])
-            if cfg.server_opt in ("adam", "yogi"):
-                new_p, server_m, server_v = _apply_server_opt(
-                    cfg, params, new_p, server_m, server_v
-                )
-            elif cfg.server_momentum > 0.0:
-                # Same helper as the sequential round — the momentum buffer
-                # rides the scan carry (replicated P() values inside
-                # shard_map, so the math is identical).
-                new_p, server_m = _apply_server_momentum(cfg, params, new_p, server_m)
+            # Same dispatch as the sequential round — the buffers ride the
+            # scan carry (replicated P() values inside shard_map, so the
+            # math is identical).
+            new_p, server_m, server_v = _apply_server_update(
+                cfg, params, new_p, server_m, server_v
+            )
             return (new_p, new_opt, server_m, server_v, extras), losses
 
         rounds = trainer_mat.shape[0]
@@ -916,15 +920,9 @@ def build_trust_round_fns(
         # verdict admitted), reconstructed from (p' - p)/server_lr on the
         # replicated arrays — identical helpers to the fused round, so
         # all-verify gated rounds match it exactly (tested).
-        server_m, server_v = state.server_m, state.server_v
-        if cfg.server_opt in ("adam", "yogi"):
-            new_params, server_m, server_v = _apply_server_opt(
-                cfg, state.params, new_params, server_m, server_v
-            )
-        elif cfg.server_momentum > 0.0:
-            new_params, server_m = _apply_server_momentum(
-                cfg, state.params, new_params, server_m
-            )
+        new_params, server_m, server_v = _apply_server_update(
+            cfg, state.params, new_params, state.server_m, state.server_v
+        )
         return PeerState(
             params=new_params,
             opt_state=kept_opt,
@@ -1416,8 +1414,22 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
     adaptive = attack in ("alie", "ipm")
     alie = attack == "alie"
     n_chunks = l_per_dev // chunk
+    # SCAFFOLD constants (option II): same derivation as the general body.
+    inv_klr = 1.0 / (cfg.local_epochs * cfg.batches_per_epoch * cfg.lr)
+    n_total = float(cfg.num_peers)
+    if adaptive and (cfg.compress != "none" or cfg.scaffold):
+        # The adaptive envelope lands ONCE post-scan, but compression's
+        # residual / scaffold's c_i are per-peer state the envelope peers
+        # would also have to update — per-attacker bookkeeping the
+        # streamed fold deliberately avoids. The unchunked general body
+        # handles these combinations (the attack runs in-band there).
+        raise ValueError(
+            f"peer_chunk with attack={attack!r} does not compose with "
+            f"compression/scaffold (adaptive envelopes land post-scan; "
+            f"use the unchunked body for this combination)"
+        )
 
-    def body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
+    def _stream_body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key, err=None, sc_c=None, sc_ci=None):
         dev = lax.axis_index(PEER_AXIS)
         local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
         round_keys = jax.vmap(lambda k: jax.random.fold_in(k, round_idx))(rng)
@@ -1435,16 +1447,31 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
         def to_chunks(leaf):
             return leaf.reshape((n_chunks, chunk) + leaf.shape[1:])
 
+        # Per-peer state families stream WITH the data: residual / c_i
+        # chunks enter each scan step and the refreshed slices come back
+        # as stacked scan outputs (reshaped to [L, ...] below).
+        extras_in = ()
+        if cfg.compress != "none":
+            extras_in = (jax.tree.map(to_chunks, err),)
+        elif cfg.scaffold:
+            extras_in = (jax.tree.map(to_chunks, sc_ci),)
         chunked = jax.tree.map(
             to_chunks, (opt_state, round_keys, x, y, local_ids, byz_gate[local_ids])
-        )
+        ) + extras_in
 
         def chunk_step(carry, inputs):
-            acc, moments = carry
-            opt_c, keys_c, x_c, y_c, ids_c, gate_c, cidx = inputs
-            new_params, _, losses = jax.vmap(
-                local_train, in_axes=(None, 0, 0, 0, 0)
-            )(pvaried, opt_c, keys_c, x_c, y_c)
+            acc, moments, dci_acc = carry
+            opt_c, keys_c, x_c, y_c, ids_c, gate_c, *extras_c, cidx = inputs
+            if cfg.scaffold:
+                (ci_c,) = extras_c
+                bias_c = jax.tree.map(lambda c, ci: c[None] - ci, sc_c, ci_c)
+                new_params, _, losses = jax.vmap(
+                    local_train, in_axes=(None, 0, 0, 0, 0, 0)
+                )(pvaried, opt_c, keys_c, x_c, y_c, bias_c)
+            else:
+                new_params, _, losses = jax.vmap(
+                    local_train, in_axes=(None, 0, 0, 0, 0)
+                )(pvaried, opt_c, keys_c, x_c, y_c)
             delta = jax.tree.map(lambda n, p: n - p[None], new_params, pvaried)
             is_trainer = jnp.isin(ids_c, trainer_idx)
             if adaptive:
@@ -1474,6 +1501,47 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
                 delta = apply_attack(
                     attack, delta, gate_c, mask_key, peer_ids=ids_c
                 )
+
+            def keep_trainers_c(n, o):
+                m = is_trainer.reshape((chunk,) + (1,) * (n.ndim - 1))
+                return jnp.where(m, n, o)
+
+            ys_extra = ()
+            if cfg.compress != "none":
+                # EF top-k per peer inside the chunk (post-attack, the
+                # general body's order); only trainers refresh their
+                # residual slice, and the SPARSIFIED delta is what folds.
+                from p2pdl_tpu.ops.compression import topk_ef
+
+                (err_c,) = extras_c
+                sent, new_err_c = topk_ef(delta, err_c, cfg.compress_ratio)
+                new_err_c = jax.tree.map(keep_trainers_c, new_err_c, err_c)
+                delta = sent
+                ys_extra = (new_err_c,)
+            elif cfg.scaffold:
+                # Option-II c_i refresh from the POST-attack delta, same
+                # as the general body; the server-c numerator accumulates
+                # across chunks and lands after the scan.
+                gate_f = is_trainer.astype(jnp.float32)
+
+                def dci_of(c, d):
+                    return -c[None] - d.astype(jnp.float32) * inv_klr
+
+                dci = jax.tree.map(dci_of, sc_c, delta)
+                new_ci_c = jax.tree.map(
+                    lambda ci, dc: ci
+                    + gate_f.reshape((chunk,) + (1,) * (dc.ndim - 1)) * dc,
+                    ci_c, dci,
+                )
+                dci_acc = jax.tree.map(
+                    lambda a, dc: a
+                    + jnp.sum(
+                        gate_f.reshape((chunk,) + (1,) * (dc.ndim - 1)) * dc,
+                        axis=0,
+                    ),
+                    dci_acc, dci,
+                )
+                ys_extra = (new_ci_c,)
             if cfg.dp_clip > 0.0:
                 # Per-peer L2 clip INSIDE the chunk — same order as the
                 # general body (post-attack, pre-masking), so chunked DP
@@ -1506,7 +1574,9 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
                 )
                 return a + jnp.sum(d * w, axis=0)
 
-            return (jax.tree.map(fold, acc, delta), moments), losses
+            return (jax.tree.map(fold, acc, delta), moments, dci_acc), (
+                losses, *ys_extra
+            )
 
         acc0 = jax.tree.map(jnp.zeros_like, pvaried)
         # Moment accumulators only exist under the adaptive attacks —
@@ -1525,9 +1595,22 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
             if adaptive
             else ()
         )
-        (acc, moments), losses = lax.scan(
-            chunk_step, (acc0, mom0), chunked + (jnp.arange(n_chunks),)
+        # Derived from pvaried (not fresh zeros) so the carry inherits the
+        # peer-varying vma type the accumulated dci has.
+        dci0 = (
+            jax.tree.map(lambda p: p.astype(jnp.float32) * 0.0, pvaried)
+            if cfg.scaffold
+            else ()
         )
+        (acc, moments, dci_acc), ys = lax.scan(
+            chunk_step, (acc0, mom0, dci0), chunked + (jnp.arange(n_chunks),)
+        )
+        losses = ys[0]
+
+        def unstack(t):  # [n_chunks, chunk, ...] -> [L, ...]
+            return jax.tree.map(
+                lambda l: l.reshape((l_per_dev,) + l.shape[2:]), t
+            )
         if adaptive:
             from p2pdl_tpu.ops.attacks import ALIE_Z, IPM_EPS
 
@@ -1576,7 +1659,41 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
         )
         # Plain SGD only (config-enforced): optimizer state is empty, so
         # "advance trainers' state" is the identity and it passes through.
+        if cfg.compress != "none":
+            return new_p, opt_state, losses.reshape(l_per_dev), unstack(ys[1])
+        if cfg.scaffold:
+            # Server c from the streamed numerator — identical math to the
+            # general body's per-leaf update (count is the live trainer
+            # count; scaffold excludes DP's fixed denominator by config).
+            mean_dci = jax.tree.map(
+                lambda a: lax.psum(a, PEER_AXIS) / count, dci_acc
+            )
+            new_c = jax.tree.map(
+                lambda c, m: c + (count / n_total) * m, sc_c, mean_dci
+            )
+            return new_p, opt_state, losses.reshape(l_per_dev), new_c, unstack(ys[1])
         return new_p, opt_state, losses.reshape(l_per_dev)
+
+    # Wrappers matching the general body's per-family signatures (what the
+    # shard_map specs in the builders are laid out for).
+    if cfg.compress != "none":
+        def body(params, opt_state, err, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
+            return _stream_body(
+                params, opt_state, rng, x, y, trainer_idx, byz_gate,
+                round_idx, mask_key, err=err,
+            )
+    elif cfg.scaffold:
+        def body(params, opt_state, sc_c, sc_ci, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
+            return _stream_body(
+                params, opt_state, rng, x, y, trainer_idx, byz_gate,
+                round_idx, mask_key, sc_c=sc_c, sc_ci=sc_ci,
+            )
+    else:
+        def body(params, opt_state, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
+            return _stream_body(
+                params, opt_state, rng, x, y, trainer_idx, byz_gate,
+                round_idx, mask_key,
+            )
 
     return body
 

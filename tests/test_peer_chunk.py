@@ -169,3 +169,71 @@ def test_peer_chunk_config_validation():
     with pytest.raises(ValueError, match="BRB"):
         Config(peer_chunk=2, brb_enabled=True)
     Config(peer_chunk=2, aggregator="secure_fedavg")
+
+
+@pytest.mark.parametrize("family", ["compress", "scaffold"])
+def test_chunked_state_family_matches_general(mesh8, family):
+    """EF compression / SCAFFOLD under peer-chunked streaming: the
+    residual / control-variate chunks ride the scan with the data and two
+    chunked rounds equal two general rounds — params AND the family state
+    (round 2 consumes round 1's state through the streaming layout)."""
+    knobs = (
+        {"compress": "topk", "compress_ratio": 0.2}
+        if family == "compress"
+        else {"scaffold": True}
+    )
+    base = Config(
+        num_peers=16,
+        trainers_per_round=6,
+        local_epochs=2,
+        samples_per_peer=8,
+        batch_size=4,
+        model="mlp",
+        dataset="mnist",
+        compute_dtype="float32",
+        **knobs,
+    )
+    fields = (
+        ("params", "compress_err")
+        if family == "compress"
+        else ("params", "scaffold_c", "scaffold_ci")
+    )
+    data = make_federated_data(base, eval_samples=16)
+    trainers = jnp.asarray([0, 2, 5, 9, 12, 14], jnp.int32)
+
+    def run(cfg):
+        state = shard_state(init_peer_state(cfg), cfg, mesh8)
+        x = jax.device_put(data.x, peer_sharding(mesh8))
+        y = jax.device_put(data.y, peer_sharding(mesh8))
+        fn = build_round_fn(cfg, mesh8)
+        for r in range(2):
+            state, _ = fn(
+                state, x, y, trainers, jnp.zeros(16), jax.random.PRNGKey(7 + r)
+            )
+        return state
+
+    want = run(base)
+    for chunk in (1, 2):
+        got = run(base.replace(peer_chunk=chunk))
+        for field in fields:
+            for a, b in zip(
+                jax.tree.leaves(getattr(got, field)),
+                jax.tree.leaves(getattr(want, field)),
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=2e-5,
+                    err_msg=f"{family}:{field}:chunk{chunk}",
+                )
+
+
+def test_chunked_family_rejects_adaptive_attacks(mesh8):
+    """The adaptive envelope lands post-scan, where per-attacker residual/
+    control bookkeeping would be needed — build_round_fn refuses the
+    combination instead of silently mis-accounting."""
+    cfg = Config(
+        num_peers=16, trainers_per_round=6, local_epochs=1, samples_per_peer=8,
+        batch_size=8, model="mlp", dataset="mnist", peer_chunk=2,
+        compress="topk",
+    )
+    with pytest.raises(ValueError, match="adaptive"):
+        build_round_fn(cfg, mesh8, attack="alie")

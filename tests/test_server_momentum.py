@@ -212,3 +212,77 @@ def test_fused_model_parallel_with_momentum_off(mesh8):
 def test_validation_server_lr_zero():
     with pytest.raises(ValueError, match="server_lr"):
         Config(**{**CFG, "server_lr": 0.0}, server_momentum=0.9)
+
+
+def test_momentum_chunked_matches_general(mesh8):
+    """FedAvgM under peer-chunked streaming: the server helper applies
+    outside the body either way, so two chunked momentum rounds equal two
+    general ones — params AND the buffer."""
+    base = Config(
+        **{**CFG, "num_peers": 16, "trainers_per_round": 6,
+           "samples_per_peer": 8, "batch_size": 4},
+        server_momentum=0.9,
+    )
+    data = make_federated_data(base, eval_samples=16)
+    trainers = jnp.asarray([0, 2, 5, 9, 12, 14], jnp.int32)
+
+    def run(cfg):
+        state = shard_state(init_peer_state(cfg), cfg, mesh8)
+        sh = peer_sharding(mesh8)
+        x = jax.device_put(data.x, sh)
+        y = jax.device_put(data.y, sh)
+        fn = build_round_fn(cfg, mesh8)
+        for r in range(2):
+            state, _ = fn(
+                state, x, y, trainers, jnp.zeros(16), jax.random.PRNGKey(r)
+            )
+        return state
+
+    want = run(base)
+    got = run(base.replace(peer_chunk=2))
+    for field in ("params", "server_m"):
+        for a, b in zip(
+            jax.tree.leaves(getattr(got, field)),
+            jax.tree.leaves(getattr(want, field)),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5, err_msg=field
+            )
+
+
+@pytest.mark.slow
+def test_momentum_seq_parallel_matches_dense(mesh8):
+    """FedAvgM under sequence parallelism: deltas (and so the
+    reconstructed pseudo-gradient) replicate across the seq axis — two
+    (peers x seq) momentum rounds equal the dense twin."""
+    from p2pdl_tpu.parallel.mesh import data_sharding, make_mesh
+
+    base = Config(
+        num_peers=4, trainers_per_round=2, local_epochs=1, samples_per_peer=8,
+        batch_size=4, model="vit_tiny", dataset="cifar10", vit_depth=2,
+        vit_pool="mean", compute_dtype="float32", lr=0.05, server_lr=1.0,
+        server_momentum=0.9, seq_shards=2,
+    )
+    results = {}
+    for sharded in (False, True):
+        cfg = base if sharded else base.replace(seq_shards=1)
+        mesh = make_mesh(8, seq_shards=2) if sharded else make_mesh(4)
+        data = make_federated_data(cfg, eval_samples=8)
+        state = shard_state(init_peer_state(cfg), cfg, mesh)
+        x = jax.device_put(data.x, data_sharding(mesh))
+        y = jax.device_put(data.y, peer_sharding(mesh))
+        fn = build_round_fn(cfg, mesh)
+        for r in range(2):
+            state, _ = fn(
+                state, x, y, jnp.asarray([0, 2], jnp.int32), jnp.zeros(4),
+                jax.random.PRNGKey(r),
+            )
+        results[sharded] = state
+    for field in ("params", "server_m"):
+        for a, b in zip(
+            jax.tree.leaves(getattr(results[True], field)),
+            jax.tree.leaves(getattr(results[False], field)),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-5, err_msg=field
+            )
