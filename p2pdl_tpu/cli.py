@@ -48,15 +48,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="FedProx proximal coefficient (0 = plain FedAvg local objective)",
     )
     p.add_argument(
-        "--compress", choices=("none", "topk"), default="none",
-        help="EF top-k update sparsification (ship only the largest "
-        "compress-ratio fraction of each delta; unsent mass carries in a "
-        "per-peer residual)",
+        "--compress", choices=("none", "topk", "qsgd"), default="none",
+        help="update compression: topk = EF sparsification (ship only the "
+        "largest compress-ratio fraction of each delta; unsent mass "
+        "carries in a per-peer residual), qsgd = unbiased stochastic "
+        "quantization to qsgd-levels levels (no residual state)",
     )
     p.add_argument(
         "--compress-ratio", type=float, default=0.1,
         help="fraction of coordinates kept per shipped update, in (0, 1] "
         "(only with --compress topk)",
+    )
+    p.add_argument(
+        "--qsgd-levels", type=int, default=256,
+        help="quantization levels for --compress qsgd (256 ~ 8-bit)",
     )
     p.add_argument(
         "--scaffold", action="store_true",
@@ -305,6 +310,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         scaffold=args.scaffold,
         compress=args.compress,
         compress_ratio=args.compress_ratio,
+        qsgd_levels=args.qsgd_levels,
         dp_clip=args.dp_clip,
         dp_noise_multiplier=args.dp_noise_multiplier,
         dp_delta=args.dp_delta,

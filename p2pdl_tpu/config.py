@@ -111,14 +111,19 @@ class Config:
     # of ||x_i - v||); > 0 = fixed L2 radius in delta units.
     cclip_tau: float = 0.0
     cclip_iters: int = 0  # 0 => aggregators.CCLIP_ITERS (one shared default)
-    # Update compression with error feedback (EF-SGD, Stich et al. 2018 /
-    # Karimireddy et al. 2019): each trainer ships only the top-k fraction
-    # of its delta's coordinates (by magnitude, over the full flattened
-    # update) and carries the unsent remainder in a per-peer residual that
-    # is added back before the next round's selection — the telescoping
-    # that makes aggressive sparsification converge. "none" = off.
-    compress: str = "none"  # "none" | "topk"
-    compress_ratio: float = 0.1  # fraction of coordinates kept per update
+    # Update compression. "topk": EF-SGD sparsification (Stich et al.
+    # 2018 / Karimireddy et al. 2019) — each trainer ships only the top-k
+    # fraction of its delta's coordinates (by magnitude, over the full
+    # flattened update) and carries the unsent remainder in a per-peer
+    # residual that is added back before the next round's selection — the
+    # telescoping that makes aggressive sparsification converge. "qsgd":
+    # stochastic uniform quantization to qsgd_levels levels (Alistarh et
+    # al. 2017) — UNBIASED, so it needs no residual state and composes
+    # everywhere the plain round does (stochastic-rounding draws keyed on
+    # global peer ids, layout-invariant). "none" = off.
+    compress: str = "none"  # "none" | "topk" | "qsgd"
+    compress_ratio: float = 0.1  # topk: fraction of coordinates kept
+    qsgd_levels: int = 256  # qsgd: quantization levels (256 ~ 8-bit)
     # SCAFFOLD (Karimireddy et al., ICML 2020): control variates correct
     # client drift at every LOCAL STEP — each peer keeps c_i, the server
     # keeps c, local steps use g + c - c_i, and after K local steps
@@ -590,15 +595,30 @@ class Config:
             )
         if not (0.0 <= self.trimmed_mean_beta < 0.5):
             raise ValueError(f"trimmed_mean_beta must be in [0, 0.5), got {self.trimmed_mean_beta}")
-        if self.compress not in ("none", "topk"):
+        if self.compress not in ("none", "topk", "qsgd"):
             raise ValueError(
-                f"unknown compress {self.compress!r}; one of ('none', 'topk')"
+                f"unknown compress {self.compress!r}; one of "
+                f"('none', 'topk', 'qsgd')"
             )
-        if self.compress != "none":
-            if not (0.0 < self.compress_ratio <= 1.0):
+        if self.compress == "topk" and not (0.0 < self.compress_ratio <= 1.0):
+            raise ValueError(
+                f"compress_ratio must be in (0, 1], got {self.compress_ratio}"
+            )
+        if self.compress == "qsgd":
+            if self.qsgd_levels < 1:
                 raise ValueError(
-                    f"compress_ratio must be in (0, 1], got {self.compress_ratio}"
+                    f"qsgd_levels must be >= 1, got {self.qsgd_levels}"
                 )
+            if self.param_dtype != "float32":
+                raise ValueError(
+                    "compress='qsgd' requires param_dtype='float32': the "
+                    "quantized values cast to the delta dtype before "
+                    "shipping, and a low-precision dtype's round-to-nearest "
+                    "adds a deterministic bias the unbiasedness guarantee "
+                    "(what justifies shipping qsgd without an EF residual) "
+                    "does not survive"
+                )
+        if self.compress != "none":
             if self.aggregator in ("gossip",):
                 raise ValueError(
                     "compress applies to shipped trainer deltas; gossip "
@@ -620,9 +640,10 @@ class Config:
                 )
             if self.dp_clip > 0.0:
                 raise ValueError(
-                    "compress with dp_clip is not supported: top-k selection "
-                    "is data-dependent per coordinate and the clip/noise "
-                    "calibration does not cover it"
+                    "compress with dp_clip is not supported: the compressor "
+                    "(top-k selection / stochastic quantization) transforms "
+                    "the update data-dependently after clipping, and the "
+                    "clip/noise sensitivity calibration does not cover it"
                 )
             # Model/sequence parallelism composes. seq: deltas are
             # replicated across the seq axis, so the local selection is

@@ -1,4 +1,4 @@
-"""Update compression with error feedback (EF top-k sparsification).
+"""Update compression: EF top-k sparsification and QSGD quantization.
 
 EF-SGD (Stich et al. 2018; Karimireddy et al. 2019 for the biased-
 compressor analysis): each trainer ships only the largest-magnitude
@@ -6,12 +6,21 @@ fraction of its update's coordinates and CARRIES THE REMAINDER — the
 residual is added back before the next round's selection, so every
 coordinate's mass eventually ships (the telescoping sum that makes
 aggressive sparsification converge where naive top-k stalls).
-
 Selection is global over the FULL flattened update (one magnitude
 threshold across all leaves — a per-leaf k would misallocate budget
-between tiny bias vectors and big kernels). The reference ships every
-update dense and uncompressed (``/root/reference/node/node.py:272-297``);
-this surface is beyond-reference.
+between tiny bias vectors and big kernels).
+
+QSGD (Alistarh et al., NeurIPS 2017): stochastic uniform quantization to
+``s`` levels of the normalized magnitude — ``q(v) = ||v|| * sign(v) *
+xi/s`` with ``xi`` the stochastically-rounded level, UNBIASED
+(``E[q(v)] = v``), so it needs no residual state: plain averaging of
+quantized updates converges, and the compressor composes everywhere the
+plain round does. One norm per peer over the full flattened update (the
+paper's single-bucket form).
+
+The reference ships every update dense and uncompressed
+(``/root/reference/node/node.py:272-297``); this surface is
+beyond-reference.
 """
 
 from __future__ import annotations
@@ -177,3 +186,68 @@ def topk_ef_sharded(
         lambda vv, s: vv - s.astype(jnp.float32), v, sent
     )
     return sent, new_err
+
+
+def qsgd(
+    delta: Any,
+    levels: int,
+    key,
+    peer_ids: jnp.ndarray,
+    axis: str | None = None,
+    sharded: Any = None,
+) -> Any:
+    """QSGD-quantize a ``[L, ...]`` peer-stacked delta tree: per peer,
+    ``q(v) = ||v||_2 * sign(v) * round_stoch(|v|/||v||_2 * s) / s``.
+
+    Stochastic rounding keys derive from ``(key, GLOBAL peer id, leaf
+    index)``, so the draws are layout-invariant — chunked and unchunked
+    rounds quantize identically (the same property the "noise" attack's
+    per-peer draws rely on).
+
+    ``axis``/``sharded`` (model-parallel layout): the per-peer norm is
+    completed by a psum of the SHARDED leaves' partial squares over the
+    model axis (replicated leaves enter once), and sharded leaves fold
+    the shard index into their rounding keys so equal-shaped slices draw
+    independent randomness while replicated leaves stay bit-identical
+    across shards — the same recipe as the DP clip/noise composition.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(delta)
+    l_per_dev = leaves[0].shape[0]
+
+    def leaf_sq(d):
+        return jnp.sum(d.astype(jnp.float32).reshape(l_per_dev, -1) ** 2, axis=1)
+
+    if axis is None:
+        sq = sum(leaf_sq(d) for d in leaves)
+        flags = [False] * len(leaves)
+    else:
+        flags = jax.tree.leaves(sharded)
+        zero = jnp.zeros((l_per_dev,), jnp.float32)
+        sh = sum((leaf_sq(d) for d, s in zip(leaves, flags) if s), zero)
+        rep = sum((leaf_sq(d) for d, s in zip(leaves, flags) if not s), zero)
+        sq = lax.psum(sh, axis) + rep
+    norm = jnp.sqrt(jnp.maximum(sq, 0.0))  # [L]
+    s = jnp.float32(levels)
+    ax_idx = lax.axis_index(axis) if axis is not None else None
+
+    def q_leaf(i, d, is_sharded):
+        v = d.astype(jnp.float32)
+        n = norm.reshape((l_per_dev,) + (1,) * (v.ndim - 1))
+        u = jnp.where(n > 0.0, jnp.abs(v) / n, 0.0) * s  # [L, ...] in [0, s]
+        lo = jnp.floor(u)
+        base = jax.random.fold_in(key, i)
+        if is_sharded:
+            base = jax.random.fold_in(base, ax_idx)
+
+        def draw(k, shape):
+            return jax.random.uniform(k, shape, jnp.float32)
+
+        # One uniform per coordinate, keyed per GLOBAL peer id.
+        us = jax.vmap(
+            lambda pid: draw(jax.random.fold_in(base, pid), v.shape[1:])
+        )(peer_ids)
+        level = lo + (us < (u - lo)).astype(jnp.float32)  # stochastic round
+        return (n * jnp.sign(v) * level / s).astype(d.dtype)
+
+    out = [q_leaf(i, d, f) for i, (d, f) in enumerate(zip(leaves, flags))]
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -180,7 +180,7 @@ def init_peer_state(cfg: Config, key: jax.Array | None = None) -> PeerState:
             lambda p: jnp.zeros((cfg.num_peers, *p.shape), jnp.float32), params
         )
     compress_err = None
-    if cfg.compress != "none":
+    if cfg.compress == "topk":  # qsgd is unbiased — no residual state
         compress_err = jax.tree.map(
             lambda p: jnp.zeros((cfg.num_peers, *p.shape), jnp.float32), params
         )

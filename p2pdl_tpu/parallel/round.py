@@ -576,10 +576,10 @@ def build_round_fn(
             in_specs=(params_spec, opt_spec, params_spec, ci_spec, sp, x_spec, sp, sr, sr, sr, sr),
             out_specs=(params_spec, opt_spec, sp, params_spec, ci_spec),
         )
-    elif cfg.compress != "none":
+    elif cfg.compress == "topk":
         # (params, opt, err, rng, x, y, tid, byz, round, key) ->
         # (params, opt, losses, err). The residual stack shards like the
-        # optimizer state.
+        # optimizer state. (qsgd is stateless and rides the plain branch.)
         smapped = jax.shard_map(
             body,
             mesh=mesh,
@@ -612,7 +612,7 @@ def build_round_fn(
             out = (new_params, new_opt, losses)
             scaffold_c, scaffold_ci = new_c, new_ci
             compress_err = state.compress_err
-        elif cfg.compress != "none":
+        elif cfg.compress == "topk":
             new_params, new_opt, losses, compress_err = smapped(
                 state.params,
                 state.opt_state,
@@ -778,7 +778,7 @@ def build_multi_round_fn(
             ("scaffold_c", params_spec),
             ("scaffold_ci", mp_extra.get("scaffold_ci", sp)),
         )
-    elif cfg.compress != "none":
+    elif cfg.compress == "topk":
         extra_fields = (("compress_err", mp_extra.get("compress_err", sp)),)
     else:
         extra_fields = ()
@@ -1451,7 +1451,7 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
         # chunks enter each scan step and the refreshed slices come back
         # as stacked scan outputs (reshaped to [L, ...] below).
         extras_in = ()
-        if cfg.compress != "none":
+        if cfg.compress == "topk":
             extras_in = (jax.tree.map(to_chunks, err),)
         elif cfg.scaffold:
             extras_in = (jax.tree.map(to_chunks, sc_ci),)
@@ -1507,7 +1507,7 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
                 return jnp.where(m, n, o)
 
             ys_extra = ()
-            if cfg.compress != "none":
+            if cfg.compress == "topk":
                 # EF top-k per peer inside the chunk (post-attack, the
                 # general body's order); only trainers refresh their
                 # residual slice, and the SPARSIFIED delta is what folds.
@@ -1542,6 +1542,15 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
                     dci_acc, dci,
                 )
                 ys_extra = (new_ci_c,)
+            elif cfg.compress == "qsgd":
+                # Stateless unbiased quantization per chunk; draws keyed on
+                # the chunk's GLOBAL peer ids, so chunked == general.
+                from p2pdl_tpu.ops.compression import qsgd
+
+                delta = qsgd(
+                    delta, cfg.qsgd_levels,
+                    jax.random.fold_in(mask_key, 0x7173), ids_c,
+                )
             if cfg.dp_clip > 0.0:
                 # Per-peer L2 clip INSIDE the chunk — same order as the
                 # general body (post-attack, pre-masking), so chunked DP
@@ -1659,7 +1668,7 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
         )
         # Plain SGD only (config-enforced): optimizer state is empty, so
         # "advance trainers' state" is the identity and it passes through.
-        if cfg.compress != "none":
+        if cfg.compress == "topk":
             return new_p, opt_state, losses.reshape(l_per_dev), unstack(ys[1])
         if cfg.scaffold:
             # Server c from the streamed numerator — identical math to the
@@ -1676,7 +1685,7 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
 
     # Wrappers matching the general body's per-family signatures (what the
     # shard_map specs in the builders are laid out for).
-    if cfg.compress != "none":
+    if cfg.compress == "topk":
         def body(params, opt_state, err, rng, x, y, trainer_idx, byz_gate, round_idx, mask_key):
             return _stream_body(
                 params, opt_state, rng, x, y, trainer_idx, byz_gate,
@@ -1719,7 +1728,7 @@ def _general_sync_body(
         dp_axis=mp_axis if cfg.dp_clip > 0.0 else None, dp_sharded=mp_sharded,
     )
 
-    if cfg.compress != "none":
+    if cfg.compress == "topk":
         # EF top-k sparsification (ops/compression.py). Per round:
         #   v_i = delta_i + err_i; ship top-k(v_i); err_i' = v_i - sent_i.
         # Only TRAINERS consume and refresh their residual (non-trainers'
@@ -1814,6 +1823,20 @@ def _general_sync_body(
         delta, new_opt, losses = train(
             params, opt_state, rng, x, y, byz_gate, round_idx, mask_key
         )
+        if cfg.compress == "qsgd":
+            # Unbiased stochastic quantization, stateless — ships in the
+            # plain body (no residual carry). Draws keyed on GLOBAL peer
+            # ids (layout-invariant); under tp/ep/pp the per-peer norm
+            # psums over the model axis (ops/compression.qsgd).
+            from p2pdl_tpu.ops.compression import qsgd
+
+            dev = lax.axis_index(PEER_AXIS)
+            local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
+            delta = qsgd(
+                delta, cfg.qsgd_levels,
+                jax.random.fold_in(mask_key, 0x7173),  # "qs"
+                local_ids, axis=mp_axis, sharded=mp_sharded,
+            )
         new_p, kept_opt = agg(
             params, opt_state, new_opt, delta, trainer_idx, mask_key, round_idx
         )

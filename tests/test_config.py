@@ -78,3 +78,13 @@ def test_package_import_orders():
             [sys.executable, "-c", code], capture_output=True, text=True, timeout=300
         )
         assert r.returncode == 0, f"{first} first: {r.stderr[-800:]}"
+
+
+def test_qsgd_guards():
+    import pytest as _pt
+
+    with _pt.raises(ValueError, match="qsgd_levels"):
+        Config(compress="qsgd", qsgd_levels=0)
+    with _pt.raises(ValueError, match="param_dtype"):
+        Config(compress="qsgd", param_dtype="bfloat16")
+    Config(compress="qsgd")  # float32 default OK
