@@ -108,6 +108,7 @@ def test_ratio_one_is_identity(mesh8):
         assert float(jnp.max(jnp.abs(e))) == 0.0
 
 
+@pytest.mark.slow  # EF math inner-covered by the unit + fused equivalence tests
 def test_sparse_training_converges_via_error_feedback(mesh8):
     """10% density training still learns — the EF telescoping at work —
     and the residual is genuinely nonzero (mass actually deferred)."""
@@ -211,9 +212,10 @@ def test_fused_equals_sequential(mesh8):
 @pytest.mark.parametrize(
     "knobs",
     [
-        # tp is the inner-loop representative: it exercises the
-        # distributed bit-bisection threshold (kth_magnitude_sharded).
-        {"tp_shards": 2, "vit_heads": 4},
+        # All four ride the slow tier: the distributed bit-bisection
+        # threshold keeps an exact inner-loop unit test
+        # (test_kth_magnitude_sharded_matches_topk).
+        pytest.param({"tp_shards": 2, "vit_heads": 4}, marks=pytest.mark.slow),
         pytest.param(
             {"seq_shards": 2, "vit_pool": "mean"}, marks=pytest.mark.slow
         ),
@@ -385,41 +387,49 @@ def test_qsgd_unbiased_and_norm_scaled(mesh8):
     assert (np.sign(draws[0])[nz] * np.sign(v)[nz] >= 0).all()
 
 
-def test_qsgd_round_learns_and_chunked_matches_general(mesh8):
-    """8-bit QSGD training converges (unbiased compression), and the
-    chunked round equals the general round bit-for-bit (stochastic
-    rounding draws key on GLOBAL peer ids — layout-invariant)."""
-    base = Config(
+def _qsgd_base():
+    return Config(
         **{**CFG, "num_peers": 16, "trainers_per_round": 8,
            "samples_per_peer": 16, "batch_size": 16},
         compress="qsgd", qsgd_levels=256,
     )
-    data = make_federated_data(base, eval_samples=256)
+
+
+def _qsgd_run(cfg, data, rounds, mesh8):
     trainers = jnp.asarray([0, 2, 4, 6, 9, 11, 13, 15], jnp.int32)
+    state = shard_state(init_peer_state(cfg), cfg, mesh8)
+    sh = peer_sharding(mesh8)
+    x = jax.device_put(data.x, sh)
+    y = jax.device_put(data.y, sh)
+    fn = build_round_fn(cfg, mesh8)
+    for r in range(rounds):
+        state, _ = fn(state, x, y, trainers, jnp.zeros(16), jax.random.PRNGKey(r))
+    return state
 
-    def run(cfg, rounds):
-        state = shard_state(init_peer_state(cfg), cfg, mesh8)
-        sh = peer_sharding(mesh8)
-        x = jax.device_put(data.x, sh)
-        y = jax.device_put(data.y, sh)
-        fn = build_round_fn(cfg, mesh8)
-        for r in range(rounds):
-            state, _ = fn(
-                state, x, y, trainers, jnp.zeros(16), jax.random.PRNGKey(r)
-            )
-        return state
 
-    state = run(base, 8)
+def test_qsgd_chunked_matches_general(mesh8):
+    """The chunked QSGD round equals the general round bit-for-bit
+    (stochastic rounding draws key on GLOBAL peer ids — layout-invariant);
+    the stateless compressor carries no residual."""
+    base = _qsgd_base()
+    data = make_federated_data(base, eval_samples=16)
+    want = _qsgd_run(base, data, 2, mesh8)
+    got = _qsgd_run(base.replace(peer_chunk=2), data, 2, mesh8)
+    assert want.compress_err is None  # stateless compressor
+    for a, b in zip(jax.tree.leaves(got.params), jax.tree.leaves(want.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+@pytest.mark.slow
+def test_qsgd_training_converges(mesh8):
+    """8-bit QSGD training converges — the unbiasedness at work."""
+    base = _qsgd_base()
+    data = make_federated_data(base, eval_samples=256)
+    state = _qsgd_run(base, data, 8, mesh8)
     acc = float(
         jnp.mean(build_eval_fn(base)(state, data.eval_x, data.eval_y)["eval_acc"])
     )
     assert acc > 0.9, acc
-    assert state.compress_err is None  # stateless compressor
-
-    want = run(base, 2)
-    got = run(base.replace(peer_chunk=2), 2)
-    for a, b in zip(jax.tree.leaves(got.params), jax.tree.leaves(want.params)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
 @pytest.mark.slow

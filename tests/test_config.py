@@ -58,6 +58,7 @@ def test_derived_properties():
     assert cfg.batches_per_epoch == 3
 
 
+@pytest.mark.slow  # subprocess interpreter spawns; regression-only
 def test_package_import_orders():
     """Both package entry orders must import cleanly: ops<->parallel have a
     real dependency cycle (parallel.round uses ops kernels; ops re-exports

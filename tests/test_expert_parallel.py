@@ -100,6 +100,7 @@ def test_moe_ffn_ep_matches_dense():
         )
 
 
+@pytest.mark.slow  # MoE grads inner-covered by test_moe_ffn_ep_matches_dense
 def test_moe_vit_forward_has_expert_grads():
     """The MoE ViT trains all its parts: gate and every expert receive
     nonzero gradients (top-1 routing spreads tokens across experts at

@@ -57,7 +57,7 @@ def _run_one_round(cfg, mesh, data, attack="none", byz=None):
         # ipm: mean-only adaptive collusion, same streaming machinery.
         ("fedavg", "ipm"),
         ("secure_fedavg", "none"),
-        ("secure_fedavg", "alie"),
+        pytest.param("secure_fedavg", "alie", marks=pytest.mark.slow),
     ],
 )
 def test_chunked_round_matches_general(mesh8, aggregator, attack):
@@ -171,7 +171,10 @@ def test_peer_chunk_config_validation():
     Config(peer_chunk=2, aggregator="secure_fedavg")
 
 
-@pytest.mark.parametrize("family", ["compress", "scaffold"])
+@pytest.mark.parametrize(
+    "family",
+    [pytest.param("compress", marks=pytest.mark.slow), "scaffold"],
+)
 def test_chunked_state_family_matches_general(mesh8, family):
     """EF compression / SCAFFOLD under peer-chunked streaming: the
     residual / control-variate chunks ride the scan with the data and two

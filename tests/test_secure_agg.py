@@ -1,6 +1,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from p2pdl_tpu.ops.secure_agg import apply_masks, pairwise_mask
 
@@ -259,6 +260,7 @@ def test_reconstructed_seeds_cancel_orphans():
     np.testing.assert_allclose(raw_sum - np.asarray(resid), honest, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_secure_masks_cancel_under_tensor_parallel(mesh8):
     """secure_fedavg composes with tp: masks draw per LOCAL slice with the
     symmetric pair key, so both endpoints of every pair generate identical

@@ -222,6 +222,7 @@ def test_mha_ulysses_matches_dense(mesh8):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
 
 
+@pytest.mark.slow  # the mha-level ulysses equivalence stays inner
 def test_vit_ulysses_round_matches_dense(mesh8):
     """cfg.seq_impl='ulysses' runs the same federated round as the dense
     twin over a (peers x seq) mesh — the second sequence-parallel family

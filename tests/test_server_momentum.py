@@ -214,6 +214,7 @@ def test_validation_server_lr_zero():
         Config(**{**CFG, "server_lr": 0.0}, server_momentum=0.9)
 
 
+@pytest.mark.slow
 def test_momentum_chunked_matches_general(mesh8):
     """FedAvgM under peer-chunked streaming: the server helper applies
     outside the body either way, so two chunked momentum rounds equal two
