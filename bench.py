@@ -576,6 +576,17 @@ def matrix_entries() -> list[dict]:
             ),
         },
         {
+            # 8-bit QSGD quantization: the stochastic-rounding cost
+            # (one uniform per coordinate + norm) next to the same
+            # 128-peer round — the stateless compressor's on-chip price.
+            "name": "cifar10_cnn_128peers_qsgd8bit",
+            "cfg": Config(
+                num_peers=128, trainers_per_round=32, local_epochs=1,
+                samples_per_peer=32, batch_size=32, model="simple_cnn",
+                dataset="cifar10", compress="qsgd", qsgd_levels=256,
+            ),
+        },
+        {
             # Bulyan: iterative-Krum selection on the centered Gram +
             # streamed middle-slice aggregation, f=7 of 32 trainers
             # (4f+3=31 <= 32) under sign-flip — the heaviest two-stage
@@ -703,6 +714,7 @@ def matrix_jobs() -> list[str]:
         "cifar10_moe_vit_8peers_fedavg",
         "cifar10_cnn_128peers_cclip_alie",
         "cifar10_cnn_128peers_topk10_ef",
+        "cifar10_cnn_128peers_qsgd8bit",
         "cifar10_cnn_128peers_bulyan_signflip",
         "cifar10_cnn_128peers_geomedian_ipm",
         "cifar10_cnn_128peers_krum_10pct_byz",

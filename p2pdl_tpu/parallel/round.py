@@ -356,7 +356,7 @@ def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
         and cfg.optimizer == "sgd"
         and cfg.dp_clip == 0.0  # per-peer clipping needs per-peer deltas
         and not cfg.scaffold  # per-peer control variates need per-peer deltas
-        and cfg.compress == "none"  # EF residuals need per-peer deltas
+        and cfg.compress == "none"  # both compressors act on per-peer deltas
         and cfg.momentum == 0.0
         and cfg.weight_decay == 0.0
         and cfg.local_epochs == 1
