@@ -18,7 +18,27 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-ATTACKS = ("none", "sign_flip", "noise", "zero", "scale", "alie", "ipm")
+ATTACKS = (
+    "none", "sign_flip", "noise", "zero", "scale", "alie", "ipm", "label_flip"
+)
+
+
+def poison_labels(
+    attack: str, y: jnp.ndarray, gate: jnp.ndarray, num_classes: int
+) -> jnp.ndarray:
+    """DATA-space poisoning, applied BEFORE local training (the model-space
+    corruptions in :func:`apply_attack` act on the trained delta after).
+
+    ``"label_flip"`` (the classic data-poisoning baseline, e.g. Fang et
+    al. 2020's comparison attack): Byzantine peers train on ``C-1-y``
+    instead of ``y`` — their honestly-computed gradients then point toward
+    systematically wrong classes, a corruption no delta-space epilogue can
+    express (the attacker's OPTIMIZER is honest; its data is not). Every
+    other attack leaves the labels untouched."""
+    if attack != "label_flip":
+        return y
+    g = gate.reshape((y.shape[0],) + (1,) * (y.ndim - 1))
+    return jnp.where(g > 0, num_classes - 1 - y, y)
 
 # ALIE perturbation magnitude in honest-update standard deviations. Baruch
 # et al. derive the largest z that keeps attackers inside the acceptance
@@ -70,7 +90,9 @@ def apply_attack(
     unchunked holds exactly for every attack, not just the deterministic
     ones. Without ids it falls back to one draw per leaf (layout-coupled).
     """
-    if attack == "none":
+    if attack in ("none", "label_flip"):
+        # label_flip corrupted the DATA before training (poison_labels);
+        # the delta ships as honestly computed — nothing to do here.
         return deltas
     if attack not in ATTACKS:
         raise ValueError(f"unknown attack {attack!r}; one of {ATTACKS}")

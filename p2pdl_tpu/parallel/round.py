@@ -48,7 +48,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from p2pdl_tpu.config import Config
 from p2pdl_tpu.ops import aggregators, sharded_aggregators
-from p2pdl_tpu.ops.attacks import apply_attack
+from p2pdl_tpu.ops.attacks import apply_attack, poison_labels
 from p2pdl_tpu.ops.gossip import exp_mix, ring_mix
 from p2pdl_tpu.ops.secure_agg import apply_masks, residual_mask_sum
 from p2pdl_tpu.parallel.mesh import (
@@ -477,6 +477,22 @@ def _apply_server_opt(cfg: Config, old_params, new_params, m, v):
         new_v,
     )
     return out_p, new_m, new_v
+
+
+def _num_classes(cfg: Config) -> int:
+    """Label-space size for data poisoning (ops.attacks.poison_labels) —
+    sourced from the SAME constants the data layer builds labels with
+    (data/federated.py), so a future dataset with a different class count
+    cannot silently desynchronize the flip range. Shakespeare labels are
+    next-char ids over the synthetic vocab (flipping them is still a
+    faithful wrong-data corruption for the char LM)."""
+    if cfg.dataset == "shakespeare":
+        from p2pdl_tpu.data.synthetic import SHAKESPEARE_VOCAB_SIZE
+
+        return SHAKESPEARE_VOCAB_SIZE
+    from p2pdl_tpu.data.federated import NUM_CLASSES
+
+    return NUM_CLASSES
 
 
 def _dp_sharded_tree(params_spec, axis):
@@ -973,11 +989,12 @@ def build_gossip_trust_round_fns(
         dev = lax.axis_index(PEER_AXIS)
         local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
         round_keys = jax.vmap(lambda k: jax.random.fold_in(k, round_idx))(rng)
+        gate = byz_gate[local_ids]
+        y = poison_labels(attack, y, gate, _num_classes(cfg))
         new_params, new_opt, losses = jax.vmap(local_train)(
             params, opt_state, round_keys, x, y
         )
         delta = jax.tree.map(lambda n, p: n - p, new_params, params)
-        gate = byz_gate[local_ids]
         delta = apply_attack(
             attack, delta, gate, mask_key,
             axis_name=PEER_AXIS, peer_ids=local_ids,
@@ -1037,11 +1054,12 @@ def _gossip_body(cfg, mesh, attack, model, opt, l_per_dev, emit_delta=False):
         dev = lax.axis_index(PEER_AXIS)
         local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
         round_keys = jax.vmap(lambda k: jax.random.fold_in(k, round_idx))(rng)
+        gate = byz_gate[local_ids]
+        y = poison_labels(attack, y, gate, _num_classes(cfg))
         new_params, new_opt, losses = jax.vmap(local_train)(
             params, opt_state, round_keys, x, y
         )
         delta = jax.tree.map(lambda n, p: n - p, new_params, params)
-        gate = byz_gate[local_ids]
         delta = apply_attack(
             attack, delta, gate, mask_key,
             axis_name=PEER_AXIS, peer_ids=local_ids,
@@ -1125,6 +1143,10 @@ def _local_train_phase(
         # Likewise along the EP axis for the non-expert leaves (the expert
         # leaves enter ep-varying via their P(ep) placement and stay so).
         pvaried = jax.lax.pcast(params, PEER_AXIS, to="varying")
+        # Data-space poisoning happens BEFORE training (a label-flipper's
+        # optimizer is honest; its data is not) — model-space corruptions
+        # apply to the delta after.
+        y = poison_labels(attack, y, byz_gate[local_ids], _num_classes(cfg))
         new_params, new_opt, losses = jax.vmap(
             local_train, in_axes=(None, 0, 0, 0, 0, 0 if with_bias else None)
         )(pvaried, opt_state, round_keys, x, y, grad_bias)
@@ -1462,6 +1484,7 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
         def chunk_step(carry, inputs):
             acc, moments, dci_acc = carry
             opt_c, keys_c, x_c, y_c, ids_c, gate_c, *extras_c, cidx = inputs
+            y_c = poison_labels(attack, y_c, gate_c, _num_classes(cfg))
             if cfg.scaffold:
                 (ci_c,) = extras_c
                 bias_c = jax.tree.map(lambda c, ci: c[None] - ci, sc_c, ci_c)

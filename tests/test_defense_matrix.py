@@ -43,9 +43,18 @@ REDUCERS = {
 # stack — the cells where "robust beats undefended" is a meaningful claim.
 SEPARATING_ATTACKS = ("sign_flip", "noise", "zero", "scale", "ipm")
 
-# Every shipped attack must appear in exactly one regime below; a new
-# attack added to ops.attacks without a matrix row fails here.
-assert set(SEPARATING_ATTACKS) | {"alie", "none"} == set(ATTACKS)
+# DATA-space poisonings corrupt labels BEFORE training — they cannot be
+# expressed on a delta stack, so their defense-discrimination lives at
+# the round level (test_round.test_label_flip_poisoning_and_median_defense).
+DATA_SPACE_ATTACKS = ("label_flip",)
+
+# Every shipped attack must appear in exactly one regime; a new attack
+# added to ops.attacks without a matrix row (or a round-level home for
+# data-space poisonings) fails here.
+assert (
+    set(SEPARATING_ATTACKS) | set(DATA_SPACE_ATTACKS) | {"alie", "none"}
+    == set(ATTACKS)
+)
 
 
 @pytest.mark.parametrize("attack", SEPARATING_ATTACKS)

@@ -49,6 +49,9 @@ def _run_one_round(cfg, mesh, data, attack="none", byz=None):
         # invariant, so chunked == unchunked holds for the stochastic
         # attack too (round-3 limitation removed).
         ("fedavg", "noise"),
+        # label_flip: DATA poisoning — labels remap inside the chunk, the
+        # delta ships honestly computed; deterministic, so exact equality.
+        ("fedavg", "label_flip"),
         # alie: the adaptive collusion streams its honest moments through
         # the chunk scan (raw-moment accumulators) and lands the envelope
         # once post-psum — equal to the unchunked body up to raw-vs-

@@ -383,3 +383,27 @@ def test_vacant_trainer_slots_match_exact_subset(mesh8):
             results.append(state.params)
         for a, b in zip(jax.tree.leaves(results[0]), jax.tree.leaves(results[1])):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_label_flip_poisoning_and_median_defense(base_cfg, mesh8):
+    """Data poisoning (label_flip): 3/8 peers train on C-1-y. Under plain
+    FedAvg the poisoned gradients drag accuracy down; coordinate-wise
+    median filters the minority and stays high — and the flippers' deltas
+    genuinely differ from honest ones (the corruption happens in-data,
+    before any delta epilogue)."""
+    byz = (1, 4, 6)
+    cfg_avg = base_cfg.replace(trainers_per_round=8, local_epochs=2)
+    _, _, ev_clean = _run_rounds(cfg_avg, mesh8, n_rounds=4)
+    _, _, ev_avg = _run_rounds(
+        cfg_avg, mesh8, n_rounds=4, attack="label_flip", byz_ids=byz
+    )
+    cfg_med = cfg_avg.replace(aggregator="median")
+    _, _, ev_med = _run_rounds(
+        cfg_med, mesh8, n_rounds=4, attack="label_flip", byz_ids=byz
+    )
+    assert ev_clean["eval_acc"] > 0.9, ev_clean
+    # The poisoning bites the undefended mean...
+    assert ev_avg["eval_acc"] < ev_clean["eval_acc"] - 0.05, (ev_avg, ev_clean)
+    # ...and the median largely shrugs it off.
+    assert ev_med["eval_acc"] > ev_avg["eval_acc"] + 0.05, (ev_med, ev_avg)
+    assert ev_med["eval_acc"] > 0.85, ev_med
