@@ -64,6 +64,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="quantization levels for --compress qsgd (256 ~ 8-bit)",
     )
     p.add_argument(
+        "--hetero-min-epochs", type=int, default=0,
+        help="straggler simulation: each peer runs tau_i ~ U[this, "
+        "local-epochs] local epochs per round (0 = homogeneous)",
+    )
+    p.add_argument(
+        "--fednova", action="store_true",
+        help="FedNova normalized averaging: trainer deltas divide by their "
+        "local step count a_i, the mean rescales by tau_eff = mean(a_i) — "
+        "objective-consistent aggregation under heterogeneous local work",
+    )
+    p.add_argument(
         "--scaffold", action="store_true",
         help="SCAFFOLD control variates (per-peer c_i + server c correct "
         "client drift at every local step; plain-SGD fedavg only)",
@@ -308,6 +319,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         server_eps=args.server_eps,
         fedprox_mu=args.fedprox_mu,
         scaffold=args.scaffold,
+        hetero_min_epochs=args.hetero_min_epochs,
+        fednova=args.fednova,
         compress=args.compress,
         compress_ratio=args.compress_ratio,
         qsgd_levels=args.qsgd_levels,

@@ -134,6 +134,22 @@ class Config:
     # float32 for the c_i stack (like gossip's peer-stacked params — the
     # algorithm's inherent cost, reference-less).
     scaffold: bool = False
+    # System heterogeneity (stragglers): peer i runs tau_i local EPOCHS,
+    # tau_i drawn uniformly from [hetero_min_epochs, local_epochs] per
+    # (seed, peer, round) — deterministic and keyed on GLOBAL peer ids, so
+    # every execution layout sees the identical straggler schedule. All
+    # peers still compile one static-shape program (frozen epochs are
+    # masked, the simulation's price for XLA-friendly control flow).
+    # 0 = off (homogeneous local_epochs everywhere).
+    hetero_min_epochs: int = 0
+    # FedNova (Wang et al., NeurIPS 2020): normalized averaging — each
+    # trainer's delta is divided by its local step count a_i = tau_i *
+    # batches_per_epoch before the mean, and the mean is rescaled by
+    # tau_eff = mean(a_i over live trainers): objective-consistent
+    # aggregation under heterogeneous local work (plain FedAvg biases
+    # toward peers that ran more steps). With homogeneous work it reduces
+    # exactly to FedAvg (a_i constant). Mean family only.
+    fednova: bool = False
     # FedProx (Li et al., MLSys 2020): proximal term (mu/2)||w - w_round||^2
     # on every local step's objective, anchored at the round's incoming
     # global params — bounds client drift over multi-epoch local training
@@ -696,6 +712,42 @@ class Config:
             # dense twin (tested per axis).
         if self.fedprox_mu < 0.0:
             raise ValueError(f"fedprox_mu must be >= 0 (0 = off), got {self.fedprox_mu}")
+        if self.hetero_min_epochs < 0 or self.hetero_min_epochs > self.local_epochs:
+            raise ValueError(
+                f"hetero_min_epochs must be in [0, local_epochs], got "
+                f"{self.hetero_min_epochs} with local_epochs={self.local_epochs}"
+            )
+        if self.hetero_min_epochs > 0 and self.scaffold:
+            raise ValueError(
+                "hetero_min_epochs with scaffold is not supported: option "
+                "II's c_i update divides by a FIXED K*lr, but heterogeneous "
+                "peers run different K"
+            )
+        if self.fednova:
+            if self.aggregator not in ("fedavg", "secure_fedavg"):
+                raise ValueError(
+                    "fednova normalizes the MEAN of trainer deltas; use a "
+                    f"mean-family aggregator, not {self.aggregator!r}"
+                )
+            if self.dp_clip > 0.0:
+                raise ValueError(
+                    "fednova with dp_clip is not supported: the tau_eff "
+                    "rescale after aggregation would scale the calibrated "
+                    "noise by a round-varying factor the epsilon accounting "
+                    "does not cover"
+                )
+            if self.scaffold:
+                raise ValueError(
+                    "fednova with scaffold is not supported (two competing "
+                    "per-step normalizations of the same delta)"
+                )
+            if self.server_momentum > 0.0 or self.server_opt != "sgd":
+                raise ValueError(
+                    "fednova with a stateful server optimizer is not yet "
+                    "supported: the (p'-p)/server_lr pseudo-gradient "
+                    "reconstruction would absorb the tau_eff rescale into "
+                    "the buffers with a round-varying scale"
+                )
         if self.dp_clip < 0.0:
             raise ValueError(f"dp_clip must be >= 0 (0 = off), got {self.dp_clip}")
         if self.dp_noise_multiplier < 0.0:

@@ -232,7 +232,7 @@ def make_local_train(
     # no longer a no-op and the gather stays.)
     shuffle = not (nb == 1 and nb * b == s and ep_axis is None)
 
-    def local_train(params, opt_state, key, x, y, grad_bias=None):
+    def local_train(params, opt_state, key, x, y, grad_bias=None, tau=None):
         # FedProx (Li et al., MLSys 2020): add (mu/2)||w - w_anchor||^2 to
         # every local step's objective, anchored at THIS round's incoming
         # params — bounds local drift over multi-step training on skewed
@@ -261,7 +261,9 @@ def make_local_train(
         else:
             step_grad = grad_fn
 
-        def epoch(carry, ekey):
+        def epoch(carry, inp):
+            ekey, e_idx = inp
+
             def batch_step(carry, batch):
                 params, opt_state = carry
                 xb, yb = batch
@@ -281,11 +283,25 @@ def make_local_train(
                 batches = (x[perm], y[perm])
             else:
                 batches = (x[None], y[None])
-            carry, losses = lax.scan(batch_step, carry, batches)
-            return carry, jnp.mean(losses)
+            new_carry, losses = lax.scan(batch_step, carry, batches)
+            loss = jnp.mean(losses)
+            if tau is not None:
+                # Straggler simulation: epochs past this peer's tau_i are
+                # computed (static shapes) but their updates are FROZEN —
+                # the peer's delta and loss are exactly a tau_i-epoch run's.
+                live = e_idx < tau
+                new_carry = jax.tree.map(
+                    lambda n, o: jnp.where(live, n, o), new_carry, carry
+                )
+                loss = jnp.where(live, loss, 0.0)
+            return new_carry, loss
 
         keys = jax.random.split(key, cfg.local_epochs)
-        (params, opt_state), epoch_losses = lax.scan(epoch, (params, opt_state), keys)
+        (params, opt_state), epoch_losses = lax.scan(
+            epoch, (params, opt_state), (keys, jnp.arange(cfg.local_epochs))
+        )
+        if tau is not None:
+            return params, opt_state, jnp.sum(epoch_losses) / tau.astype(jnp.float32)
         return params, opt_state, jnp.mean(epoch_losses)
 
     return local_train
@@ -357,6 +373,8 @@ def _use_fast_sync_path(cfg: Config, attack: str) -> bool:
         and cfg.dp_clip == 0.0  # per-peer clipping needs per-peer deltas
         and not cfg.scaffold  # per-peer control variates need per-peer deltas
         and cfg.compress == "none"  # both compressors act on per-peer deltas
+        and not cfg.fednova  # per-peer delta normalization
+        and cfg.hetero_min_epochs == 0  # per-peer epoch masking
         and cfg.momentum == 0.0
         and cfg.weight_decay == 0.0
         and cfg.local_epochs == 1
@@ -477,6 +495,64 @@ def _apply_server_opt(cfg: Config, old_params, new_params, m, v):
         new_v,
     )
     return out_p, new_m, new_v
+
+
+def _epoch_counts(cfg: Config, peer_ids, round_idx):
+    """Per-peer local epoch counts ``tau_i`` for the straggler simulation
+    (``cfg.hetero_min_epochs``): uniform over
+    ``[hetero_min_epochs, local_epochs]``, keyed on (seed, GLOBAL peer id,
+    round) — deterministic and layout-invariant, so every execution mode
+    (vmap width, peer_chunk, fused rounds) sees the identical straggler
+    schedule and chunked == general holds exactly. ``None`` when the
+    simulation is off (homogeneous ``local_epochs``)."""
+    if cfg.hetero_min_epochs == 0:
+        return None
+    key = jax.random.fold_in(
+        jax.random.PRNGKey(cfg.seed ^ 0x48455401), round_idx  # "HET"
+    )
+    return jax.vmap(
+        lambda pid: jax.random.randint(
+            jax.random.fold_in(key, pid), (),
+            cfg.hetero_min_epochs, cfg.local_epochs + 1,
+        )
+    )(peer_ids)
+
+
+def _local_steps(cfg: Config, peer_ids, round_idx):
+    """``a_i`` — each peer's local STEP count this round (tau_i x batches
+    per epoch), the FedNova normalizer. ``[L]`` float32."""
+    tau = _epoch_counts(cfg, peer_ids, round_idx)
+    if tau is None:
+        tau = jnp.full(peer_ids.shape, cfg.local_epochs, jnp.int32)
+    return (tau * cfg.batches_per_epoch).astype(jnp.float32)
+
+
+def _fednova_normalize(delta, a, lead: int):
+    """Divide each of the leading ``lead`` stacked updates by its step
+    count ``a`` (``[lead]`` float32) — FedNova's per-trainer d_i =
+    delta_i / a_i. Shared by the general and chunked bodies so the two
+    cannot drift (their equivalence is test-asserted)."""
+    return jax.tree.map(
+        lambda d: (
+            d.astype(jnp.float32) / a.reshape((lead,) + (1,) * (d.ndim - 1))
+        ).astype(d.dtype),
+        delta,
+    )
+
+
+def _fednova_tau_eff(is_trainer, a):
+    """``tau_eff = mean(a_i over live trainers)`` — the FedNova rescale of
+    the normalized mean. Cross-device: psums over the peer axis."""
+    live = jnp.maximum(
+        lax.psum(jnp.sum(is_trainer.astype(jnp.float32)), PEER_AXIS), 1.0
+    )
+    return lax.psum(jnp.sum(jnp.where(is_trainer, a, 0.0)), PEER_AXIS) / live
+
+
+def _fednova_rescale(agg, tau_eff):
+    return jax.tree.map(
+        lambda x: (x.astype(jnp.float32) * tau_eff).astype(x.dtype), agg
+    )
 
 
 def _num_classes(cfg: Config) -> int:
@@ -991,9 +1067,11 @@ def build_gossip_trust_round_fns(
         round_keys = jax.vmap(lambda k: jax.random.fold_in(k, round_idx))(rng)
         gate = byz_gate[local_ids]
         y = poison_labels(attack, y, gate, _num_classes(cfg))
-        new_params, new_opt, losses = jax.vmap(local_train)(
-            params, opt_state, round_keys, x, y
-        )
+        tau = _epoch_counts(cfg, local_ids, round_idx)
+        new_params, new_opt, losses = jax.vmap(
+            local_train,
+            in_axes=(0, 0, 0, 0, 0, None, 0 if tau is not None else None),
+        )(params, opt_state, round_keys, x, y, None, tau)
         delta = jax.tree.map(lambda n, p: n - p, new_params, params)
         delta = apply_attack(
             attack, delta, gate, mask_key,
@@ -1056,9 +1134,11 @@ def _gossip_body(cfg, mesh, attack, model, opt, l_per_dev, emit_delta=False):
         round_keys = jax.vmap(lambda k: jax.random.fold_in(k, round_idx))(rng)
         gate = byz_gate[local_ids]
         y = poison_labels(attack, y, gate, _num_classes(cfg))
-        new_params, new_opt, losses = jax.vmap(local_train)(
-            params, opt_state, round_keys, x, y
-        )
+        tau = _epoch_counts(cfg, local_ids, round_idx)
+        new_params, new_opt, losses = jax.vmap(
+            local_train,
+            in_axes=(0, 0, 0, 0, 0, None, 0 if tau is not None else None),
+        )(params, opt_state, round_keys, x, y, None, tau)
         delta = jax.tree.map(lambda n, p: n - p, new_params, params)
         delta = apply_attack(
             attack, delta, gate, mask_key,
@@ -1147,9 +1227,14 @@ def _local_train_phase(
         # optimizer is honest; its data is not) — model-space corruptions
         # apply to the delta after.
         y = poison_labels(attack, y, byz_gate[local_ids], _num_classes(cfg))
+        tau = _epoch_counts(cfg, local_ids, round_idx)
         new_params, new_opt, losses = jax.vmap(
-            local_train, in_axes=(None, 0, 0, 0, 0, 0 if with_bias else None)
-        )(pvaried, opt_state, round_keys, x, y, grad_bias)
+            local_train,
+            in_axes=(
+                None, 0, 0, 0, 0, 0 if with_bias else None,
+                0 if tau is not None else None,
+            ),
+        )(pvaried, opt_state, round_keys, x, y, grad_bias, tau)
 
         if ep_axis is not None:
             # local_train reports its 1/ep-scaled shard-slice loss mean;
@@ -1252,6 +1337,18 @@ def _aggregate_phase(
         local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
         is_trainer = jnp.isin(local_ids, trainer_idx)
 
+        tau_eff = None
+        if cfg.fednova:
+            # FedNova (Wang et al. 2020): each trainer SHIPS its
+            # step-normalized delta d_i = delta_i / a_i (so masking/
+            # robust semantics see the normalized update), and the mean
+            # is rescaled by tau_eff = mean(a_i over live trainers) after
+            # aggregation. Homogeneous work: a_i constant => exactly
+            # FedAvg (test-asserted).
+            a = _local_steps(cfg, local_ids, round_idx)  # [L]
+            delta = _fednova_normalize(delta, a, l_per_dev)
+            tau_eff = _fednova_tau_eff(is_trainer, a)
+
         if cfg.dp_clip > 0.0:
             # DP-FedAvg clipping (McMahan et al. 2018): bound each peer's
             # L2 contribution BEFORE masking and aggregation — on the raw
@@ -1339,6 +1436,8 @@ def _aggregate_phase(
                     lambda a: a,
                     agg,
                 )
+            if tau_eff is not None:
+                agg = _fednova_rescale(agg, tau_eff)
         elif cfg.robust_impl == "blockwise":
             # Stream the peer axis through feature blocks: O(P x block)
             # transient instead of O(P x model) per device (SURVEY §7 hard
@@ -1439,7 +1538,7 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
     # SCAFFOLD constants (option II): same derivation as the general body.
     inv_klr = 1.0 / (cfg.local_epochs * cfg.batches_per_epoch * cfg.lr)
     n_total = float(cfg.num_peers)
-    if adaptive and (cfg.compress != "none" or cfg.scaffold):
+    if adaptive and (cfg.compress != "none" or cfg.scaffold or cfg.fednova):
         # The adaptive envelope lands ONCE post-scan, but compression's
         # residual / scaffold's c_i are per-peer state the envelope peers
         # would also have to update — per-attacker bookkeeping the
@@ -1447,7 +1546,7 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
         # handles these combinations (the attack runs in-band there).
         raise ValueError(
             f"peer_chunk with attack={attack!r} does not compose with "
-            f"compression/scaffold (adaptive envelopes land post-scan; "
+            f"compression/scaffold/fednova (adaptive envelopes land post-scan; "
             f"use the unchunked body for this combination)"
         )
 
@@ -1477,24 +1576,35 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
             extras_in = (jax.tree.map(to_chunks, err),)
         elif cfg.scaffold:
             extras_in = (jax.tree.map(to_chunks, sc_ci),)
+        tau_all = _epoch_counts(cfg, local_ids, round_idx)
+        tau_eff = None
+        if cfg.fednova:
+            tau_eff = _fednova_tau_eff(
+                is_trainer_all, _local_steps(cfg, local_ids, round_idx)
+            )
         chunked = jax.tree.map(
             to_chunks, (opt_state, round_keys, x, y, local_ids, byz_gate[local_ids])
-        ) + extras_in
+        ) + ((to_chunks(tau_all),) if tau_all is not None else ()) + extras_in
 
         def chunk_step(carry, inputs):
             acc, moments, dci_acc = carry
-            opt_c, keys_c, x_c, y_c, ids_c, gate_c, *extras_c, cidx = inputs
+            opt_c, keys_c, x_c, y_c, ids_c, gate_c, *rest, cidx = inputs
+            if tau_all is not None:
+                tau_c, *extras_c = rest
+            else:
+                tau_c, extras_c = None, rest
             y_c = poison_labels(attack, y_c, gate_c, _num_classes(cfg))
+            tau_ax = 0 if tau_c is not None else None
             if cfg.scaffold:
                 (ci_c,) = extras_c
                 bias_c = jax.tree.map(lambda c, ci: c[None] - ci, sc_c, ci_c)
                 new_params, _, losses = jax.vmap(
-                    local_train, in_axes=(None, 0, 0, 0, 0, 0)
-                )(pvaried, opt_c, keys_c, x_c, y_c, bias_c)
+                    local_train, in_axes=(None, 0, 0, 0, 0, 0, tau_ax)
+                )(pvaried, opt_c, keys_c, x_c, y_c, bias_c, tau_c)
             else:
                 new_params, _, losses = jax.vmap(
-                    local_train, in_axes=(None, 0, 0, 0, 0)
-                )(pvaried, opt_c, keys_c, x_c, y_c)
+                    local_train, in_axes=(None, 0, 0, 0, 0, None, tau_ax)
+                )(pvaried, opt_c, keys_c, x_c, y_c, None, tau_c)
             delta = jax.tree.map(lambda n, p: n - p[None], new_params, pvaried)
             is_trainer = jnp.isin(ids_c, trainer_idx)
             if adaptive:
@@ -1574,6 +1684,21 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
                     delta, cfg.qsgd_levels,
                     jax.random.fold_in(mask_key, 0x7173), ids_c,
                 )
+            if cfg.fednova:
+                # Step-normalization AFTER the compressor, matching the
+                # general path (compress in-body, fednova in the agg
+                # phase) so chunked == general exactly. a_i comes from the
+                # tau chunk already streaming through the scan (or the
+                # static homogeneous count).
+                if tau_c is not None:
+                    a_c = (tau_c * cfg.batches_per_epoch).astype(jnp.float32)
+                else:
+                    a_c = jnp.full(
+                        (chunk,),
+                        cfg.local_epochs * cfg.batches_per_epoch,
+                        jnp.float32,
+                    )
+                delta = _fednova_normalize(delta, a_c, chunk)
             if cfg.dp_clip > 0.0:
                 # Per-peer L2 clip INSIDE the chunk — same order as the
                 # general body (post-attack, pre-masking), so chunked DP
@@ -1684,6 +1809,8 @@ def _chunked_sync_body(cfg, attack, model, opt, l_per_dev, pair_seeds=None):
             agg = jax.tree.map(
                 lambda a: lax.psum(a, PEER_AXIS) / count.astype(a.dtype), acc
             )
+        if tau_eff is not None:
+            agg = _fednova_rescale(agg, tau_eff)
         if cfg.dp_noise_multiplier > 0.0:
             agg = _dp_noise_tree(cfg, agg, mask_key)
         new_p = jax.tree.map(
