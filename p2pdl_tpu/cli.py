@@ -64,6 +64,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="quantization levels for --compress qsgd (256 ~ 8-bit)",
     )
     p.add_argument(
+        "--selection", choices=("uniform", "power_of_choice"), default="uniform",
+        help="trainer sampler: uniform (reference semantics) or "
+        "power_of_choice (Cho et al. 2020 — poc-candidates uniform "
+        "candidates, keep the highest-loss trainers)",
+    )
+    p.add_argument(
+        "--poc-candidates", type=int, default=0,
+        help="power_of_choice candidate pool size d (0 = auto: "
+        "min(2 x trainers, peers))",
+    )
+    p.add_argument(
         "--hetero-min-epochs", type=int, default=0,
         help="straggler simulation: each peer runs tau_i ~ U[this, "
         "local-epochs] local epochs per round (0 = homogeneous)",
@@ -319,6 +330,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         server_eps=args.server_eps,
         fedprox_mu=args.fedprox_mu,
         scaffold=args.scaffold,
+        selection=args.selection,
+        poc_candidates=args.poc_candidates,
         hetero_min_epochs=args.hetero_min_epochs,
         fednova=args.fednova,
         compress=args.compress,

@@ -134,6 +134,16 @@ class Config:
     # float32 for the c_i stack (like gossip's peer-stacked params — the
     # algorithm's inherent cost, reference-less).
     scaffold: bool = False
+    # Client selection (the host round driver's trainer sampler).
+    # "uniform" = the reference's random sample (main.py:52-54).
+    # "power_of_choice" = biased selection (Cho et al. 2020): draw
+    # poc_candidates candidates uniformly, then pick the trainers_per_round
+    # with the HIGHEST last-known local loss — faster early convergence on
+    # skewed shards at a well-characterized fairness cost. Loss state is
+    # observational runtime state (like the failure-suspicion table): round
+    # 1 and the first post-resume round fall back to uniform.
+    selection: str = "uniform"
+    poc_candidates: int = 0  # 0 = auto: min(2 x trainers_per_round, num_peers)
     # System heterogeneity (stragglers): peer i runs tau_i local EPOCHS,
     # tau_i drawn uniformly from [hetero_min_epochs, local_epochs] per
     # (seed, peer, round) — deterministic and keyed on GLOBAL peer ids, so
@@ -712,6 +722,22 @@ class Config:
             # dense twin (tested per axis).
         if self.fedprox_mu < 0.0:
             raise ValueError(f"fedprox_mu must be >= 0 (0 = off), got {self.fedprox_mu}")
+        if self.selection not in ("uniform", "power_of_choice"):
+            raise ValueError(
+                f"unknown selection {self.selection!r}; one of "
+                f"('uniform', 'power_of_choice')"
+            )
+        if self.poc_candidates < 0 or self.poc_candidates > self.num_peers:
+            raise ValueError(
+                f"poc_candidates must be in [0, num_peers], got "
+                f"{self.poc_candidates}"
+            )
+        if 0 < self.poc_candidates < self.trainers_per_round:
+            raise ValueError(
+                f"poc_candidates ({self.poc_candidates}) must be >= "
+                f"trainers_per_round ({self.trainers_per_round}) — the "
+                f"candidate pool must fill the trainer quorum"
+            )
         if self.hetero_min_epochs < 0 or self.hetero_min_epochs > self.local_epochs:
             raise ValueError(
                 f"hetero_min_epochs must be in [0, local_epochs], got "

@@ -329,6 +329,11 @@ class Experiment:
         self.trust = _TrustPlane(cfg, byz_ids) if cfg.brb_enabled else None
         self.profiler = Profiler(profile_dir)
 
+        # Last known per-peer local losses (power_of_choice selection).
+        # OBSERVATIONAL runtime state, like the failure-suspicion table:
+        # not checkpointed, so the first post-resume round samples
+        # uniformly where the uninterrupted run may have biased.
+        self._peer_losses = None
         self.checkpointer = None
         self.checkpoint_every = max(1, checkpoint_every)
         # Experiment identity beyond the Config — validated on resume so a
@@ -386,7 +391,22 @@ class Experiment:
             # Robust reducers need their full [T] update matrix: degrade to
             # the full peer set rather than shrinking the trainer quorum.
             eligible = np.arange(self.cfg.num_peers)
-        return np.sort(rng.choice(eligible, self.cfg.trainers_per_round, replace=False))
+        t = self.cfg.trainers_per_round
+        if (
+            self.cfg.selection == "power_of_choice"
+            and self._peer_losses is not None
+        ):
+            # Power-of-Choice (Cho et al. 2020): d uniform candidates, keep
+            # the T with the highest last-known local loss. The candidate
+            # draw stays keyed on (seed, round) like the uniform sampler.
+            d = self.cfg.poc_candidates or min(2 * t, len(eligible))
+            d = max(t, min(d, len(eligible)))
+            candidates = rng.choice(eligible, d, replace=False)
+            by_loss = candidates[
+                np.argsort(-np.asarray(self._peer_losses)[candidates])
+            ]
+            return np.sort(by_loss[:t])
+        return np.sort(rng.choice(eligible, t, replace=False))
 
     def _run_trust_plane(self, r: int, live: np.ndarray, delta) -> tuple:
         """Digest each live trainer's on-device delta, BRB-broadcast the
@@ -489,7 +509,8 @@ class Experiment:
                 delta, new_opt, losses_dev = self.train_fn(
                     self.state, self.x, self.y, self.byz_gate, mask_key
                 )
-                losses = np.asarray(losses_dev)[live]
+                self._peer_losses = np.asarray(losses_dev)  # [P]
+                losses = self._peer_losses[live]
                 train_loss = float(np.mean(losses))
             with self.profiler.phase("brb"):
                 brb_delivered, brb_failed, brb_excluded, verified, msgs, nbytes = (
@@ -586,6 +607,7 @@ class Experiment:
                 # runs). Gossip has no roles: every peer trains, so every
                 # loss counts.
                 losses = np.asarray(m["train_loss"])
+                self._peer_losses = losses  # [P] — feeds biased selection
                 if self.cfg.aggregator != "gossip":
                     losses = losses[live]
                 train_loss = float(np.mean(losses))
@@ -658,6 +680,14 @@ class Experiment:
         complete (per-block streaming for CLI/monitoring)."""
         if self.trust is not None:
             raise ValueError("run_fused requires brb_enabled=False")
+        if self.cfg.selection == "power_of_choice":
+            raise ValueError(
+                "run_fused with selection='power_of_choice' is not "
+                "supported: the whole block's trainer rows are sampled "
+                "before any of its rounds run, so the per-round loss "
+                "feedback the biased sampler needs does not exist inside "
+                "a fused block — use run() for biased selection"
+            )
         from p2pdl_tpu.parallel import build_multi_round_fn
 
         if not hasattr(self, "_multi_round_fn"):
@@ -680,6 +710,7 @@ class Experiment:
                     base_key,
                 )
                 losses = np.asarray(m["train_loss"])  # [R, P]
+                self._peer_losses = losses[-1]  # feeds biased selection
             dt = (time.perf_counter() - t0) / block
             with self.profiler.phase("eval"):
                 ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)
