@@ -27,7 +27,7 @@ AGGREGATORS = (
     "gossip",  # selects the ring topology: decentralized D-PSGD neighbor mixing
     "secure_fedavg",
 )
-MODELS = ("mlp", "simple_cnn", "resnet18", "char_lstm", "vit_tiny")
+MODELS = ("mlp", "simple_cnn", "resnet18", "char_lstm", "vit_tiny", "char_gpt")
 DATASETS = ("mnist", "cifar10", "shakespeare", "synthetic")
 PARTITIONS = ("iid", "dirichlet")
 
@@ -406,9 +406,9 @@ class Config:
             raise ValueError(
                 f"unknown attn_impl {self.attn_impl!r}; one of ('dense', 'flash')"
             )
-        if self.attn_impl == "flash" and self.model != "vit_tiny":
+        if self.attn_impl == "flash" and self.model not in ("vit_tiny", "char_gpt"):
             raise ValueError(
-                f"attn_impl='flash' requires an attention model (vit_tiny); "
+                f"attn_impl='flash' requires an attention model (vit_tiny/char_gpt); "
                 f"model={self.model!r} has no attention"
             )
         if self.vit_pool not in ("cls", "mean"):
@@ -738,6 +738,12 @@ class Config:
                 f"trainers_per_round ({self.trainers_per_round}) — the "
                 f"candidate pool must fill the trainer quorum"
             )
+        if self.selection == "power_of_choice" and self.aggregator == "gossip":
+            raise ValueError(
+                "selection='power_of_choice' has no effect under gossip "
+                "(every peer trains and mixes regardless of the sampled "
+                "trainer vector) — biased selection is a sync-layout tool"
+            )
         if self.hetero_min_epochs < 0 or self.hetero_min_epochs > self.local_epochs:
             raise ValueError(
                 f"hetero_min_epochs must be in [0, local_epochs], got "
@@ -821,10 +827,13 @@ class Config:
                 f"batch_size ({self.batch_size})"
             )
         # Model/dataset compatibility (shape-checked again at init time).
-        if self.model == "char_lstm" and self.dataset != "shakespeare":
-            raise ValueError("char_lstm requires dataset='shakespeare'")
-        if self.model != "char_lstm" and self.dataset == "shakespeare":
-            raise ValueError("dataset='shakespeare' requires model='char_lstm'")
+        if self.model in ("char_lstm", "char_gpt") and self.dataset != "shakespeare":
+            raise ValueError(f"{self.model} requires dataset='shakespeare'")
+        if self.model not in ("char_lstm", "char_gpt") and self.dataset == "shakespeare":
+            raise ValueError(
+                "dataset='shakespeare' requires a sequence model "
+                "(char_lstm or char_gpt)"
+            )
         if self.model in ("resnet18", "vit_tiny") and self.dataset != "cifar10":
             raise ValueError(f"{self.model} requires dataset='cifar10'")
         # Krum's selection guarantee needs T >= 2f + 3 (Blanchard et al. 2017);

@@ -38,6 +38,10 @@ def get_model(name: str, **kwargs: Any):
         from p2pdl_tpu.models.vit import ViTTiny
 
         return ViTTiny(**kwargs)
+    if name == "char_gpt":
+        from p2pdl_tpu.models.gpt import CharGPT
+
+        return CharGPT(**kwargs)
     raise ValueError(f"unknown model {name!r}")
 
 
@@ -47,7 +51,7 @@ def model_input_spec(model_name: str, dataset: str, seq_len: int = 128) -> tuple
     Image models take the dataset's native shape (MLP flattens internally, so
     it serves both 28x28x1 and 32x32x3); sequence models take int tokens.
     """
-    if model_name == "char_lstm":
+    if model_name in ("char_lstm", "char_gpt"):
         return (seq_len,), jnp.int32
     image_shape = (32, 32, 3) if dataset == "cifar10" else (28, 28, 1)
     if model_name in ("mlp", "simple_cnn"):

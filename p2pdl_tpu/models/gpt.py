@@ -1,0 +1,47 @@
+"""CharGPT: a small causal (decoder-only) transformer LM for next-char
+prediction on the shakespeare task.
+
+The CAUSAL training counterpart to the CharLSTM (the reference has no
+sequence model of any kind — its model zoo is MLP + SimpleCNN,
+``/root/reference/models/models.py``; both sequence families here are
+beyond-reference): token + learned position embeddings, pre-LN
+transformer blocks with causally-masked attention (the same
+``MultiHeadAttention`` the ViT uses, ``causal=True`` — dense SDPA or the
+fused Pallas flash kernels, whose causal path otherwise only ran in the
+attention microbench), and a tied-free vocab head. Logits are ``[B, T,
+vocab]``; the loss/eval plumbing already handles sequence outputs (the
+CharLSTM path).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from p2pdl_tpu.models.vit import TransformerBlock
+
+
+class CharGPT(nn.Module):
+    vocab_size: int
+    dim: int = 192
+    depth: int = 4
+    heads: int = 3
+    max_len: int = 512
+    attn_impl: str = "dense"  # "dense" | "flash" (fused Pallas kernels)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:  # [B, T] int tokens
+        t = x.shape[-1]
+        if t > self.max_len:
+            raise ValueError(f"sequence length {t} exceeds max_len {self.max_len}")
+        h = nn.Embed(self.vocab_size, self.dim)(x)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (self.max_len, self.dim)
+        )
+        h = h + pos[None, :t].astype(h.dtype)
+        for _ in range(self.depth):
+            h = TransformerBlock(
+                self.dim, self.heads, causal=True, attn_impl=self.attn_impl
+            )(h)
+        h = nn.LayerNorm()(h)
+        return nn.Dense(self.vocab_size)(h)

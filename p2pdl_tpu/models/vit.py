@@ -31,6 +31,9 @@ class TransformerBlock(nn.Module):
     dim: int
     heads: int
     mlp_ratio: int = 4
+    # Causal masking (decoder-only LMs — models/gpt.py); the ViT uses the
+    # default bidirectional attention.
+    causal: bool = False
     attn_impl: str = "dense"
     seq_axis: str | None = None
     seq_impl: str = "ring"  # "ring" | "ulysses" (with seq_axis set)
@@ -55,6 +58,7 @@ class TransformerBlock(nn.Module):
         x = x + MultiHeadAttention(
             self.dim,
             self.heads,
+            causal=self.causal,
             impl=self.attn_impl,
             seq_axis=self.seq_axis,
             seq_impl=self.seq_impl,

@@ -114,10 +114,13 @@ def build_model(
     one. (With ``cfg.pp_shards > 1`` the dense twin still uses the
     scan-blocks stacked layout so the pytrees match.)"""
     kwargs: dict[str, Any] = {}
-    if cfg.model == "char_lstm":
+    if cfg.model in ("char_lstm", "char_gpt"):
         from p2pdl_tpu.data.synthetic import SHAKESPEARE_VOCAB_SIZE
 
         kwargs["vocab_size"] = SHAKESPEARE_VOCAB_SIZE
+    if cfg.model == "char_gpt":
+        kwargs["attn_impl"] = cfg.attn_impl
+        kwargs["max_len"] = cfg.seq_len  # exactly-sized pos-embed table
     if cfg.model == "vit_tiny":
         kwargs["attn_impl"] = cfg.attn_impl
         kwargs["pool"] = cfg.vit_pool

@@ -1,5 +1,6 @@
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from p2pdl_tpu.models import get_model, init_params, model_input_spec
@@ -85,3 +86,63 @@ def test_bf16_compute():
     bf16_params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
     out = model.apply({"params": bf16_params}, jnp.zeros((2, 784), jnp.bfloat16))
     assert out.dtype == jnp.bfloat16
+
+
+def test_char_gpt_forward_and_causality():
+    """CharGPT: [B, T] tokens -> [B, T, vocab] logits, and the attention is
+    genuinely CAUSAL — logits at position t are invariant to any change in
+    tokens after t."""
+    model = get_model("char_gpt", vocab_size=80, depth=2)
+    params = init_params(model, (16,), jnp.int32, jax.random.PRNGKey(0))
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 80)
+    out = model.apply({"params": params}, x)
+    assert out.shape == (2, 16, 80)
+    # Perturb the FUTURE: logits up to the perturbation point must not move.
+    x2 = x.at[:, 10:].set((x[:, 10:] + 7) % 80)
+    out2 = model.apply({"params": params}, x2)
+    np.testing.assert_array_equal(np.asarray(out[:, :10]), np.asarray(out2[:, :10]))
+    assert not np.allclose(np.asarray(out[:, 10:]), np.asarray(out2[:, 10:]))
+
+
+def test_char_gpt_round_learns(mesh8):
+    """A federated next-char round on shakespeare with the causal
+    transformer: loss drops over rounds (the causal-attention TRAINING
+    path, not just the microbench)."""
+    from p2pdl_tpu.config import Config
+    from p2pdl_tpu.data import make_federated_data
+    from p2pdl_tpu.parallel import (
+        build_round_fn, init_peer_state, peer_sharding, shard_state,
+    )
+
+    cfg = Config(
+        num_peers=8, trainers_per_round=8, local_epochs=3, samples_per_peer=16,
+        batch_size=16, model="char_gpt", dataset="shakespeare", seq_len=32,
+        lr=0.01, server_lr=1.0, optimizer="adam", compute_dtype="float32",
+    )
+    data = make_federated_data(cfg, eval_samples=32)
+    state = shard_state(init_peer_state(cfg), cfg, mesh8)
+    sh = peer_sharding(mesh8)
+    x = jax.device_put(data.x, sh)
+    y = jax.device_put(data.y, sh)
+    fn = build_round_fn(cfg, mesh8)
+    tid = jnp.arange(8, dtype=jnp.int32)
+    losses = []
+    for r in range(5):
+        state, m = fn(state, x, y, tid, jnp.zeros(8), jax.random.PRNGKey(r))
+        losses.append(float(jnp.mean(m["train_loss"])))
+    assert losses[-1] < losses[0] - 0.3, losses
+
+
+def test_char_gpt_flash_matches_dense():
+    """Model-level causal FLASH attention (the fused Pallas kernels inside
+    a decoder-only LM) equals the dense SDPA forward on the same params —
+    the causal kernel path in a real model, not just the microbench."""
+    dense = get_model("char_gpt", vocab_size=80, depth=2)
+    flash = get_model("char_gpt", vocab_size=80, depth=2, attn_impl="flash")
+    params = init_params(dense, (128,), jnp.int32, jax.random.PRNGKey(0))
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0, 80)
+    out_d = dense.apply({"params": params}, x)
+    out_f = flash.apply({"params": params}, x)
+    np.testing.assert_allclose(
+        np.asarray(out_f), np.asarray(out_d), atol=2e-4
+    )

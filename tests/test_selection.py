@@ -90,3 +90,8 @@ def test_poc_rejected_under_fused_execution(mesh8):
     exp = Experiment(Config(**CFG, selection="power_of_choice"))
     with pytest.raises(ValueError, match="fused"):
         exp.run_fused()
+
+
+def test_poc_rejected_for_gossip():
+    with pytest.raises(ValueError, match="gossip"):
+        Config(**{**CFG, "aggregator": "gossip"}, selection="power_of_choice")
