@@ -104,6 +104,7 @@ def test_char_gpt_forward_and_causality():
     assert not np.allclose(np.asarray(out[:, 10:]), np.asarray(out2[:, 10:]))
 
 
+@pytest.mark.slow  # forward/causality/flash tests keep inner coverage
 def test_char_gpt_round_learns(mesh8):
     """A federated next-char round on shakespeare with the causal
     transformer: loss drops over rounds (the causal-attention TRAINING
