@@ -86,6 +86,7 @@ def test_kth_magnitude_sharded_matches_topk(mesh8):
         np.testing.assert_array_equal(np.asarray(got), want, err_msg=f"k={k}")
 
 
+@pytest.mark.slow  # identity oracle; the unit + fused equivalence tests stay inner
 def test_ratio_one_is_identity(mesh8):
     """ratio=1 ships everything: params bit-match the uncompressed round
     and the residual stays zero."""

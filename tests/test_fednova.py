@@ -111,11 +111,11 @@ def test_straggler_freeze_is_a_real_truncation():
     )
 
 
-def test_hetero_fednova_learns_and_chunked_matches_general(mesh8):
-    """Heterogeneous epochs [1,3] + FedNova: training converges, the
-    straggler schedule is layout-invariant (chunked == general exactly),
-    and the trajectory genuinely differs from plain FedAvg under the same
-    heterogeneity (the normalization is live)."""
+def test_hetero_fednova_chunked_matches_general(mesh8):
+    """Heterogeneous epochs [1,3] + FedNova: the straggler schedule is
+    layout-invariant (chunked == general exactly) and the trajectory
+    genuinely differs from plain FedAvg under the same heterogeneity
+    (the normalization is live). Convergence rides the slow tier."""
     base = Config(
         **{**CFG, "num_peers": 16, "trainers_per_round": 8,
            "samples_per_peer": 16},
@@ -135,12 +135,6 @@ def test_hetero_fednova_learns_and_chunked_matches_general(mesh8):
                 state, x, y, trainers, jnp.zeros(16), jax.random.PRNGKey(r)
             )
         return state
-
-    state = run(base, 6)
-    acc = float(
-        jnp.mean(build_eval_fn(base)(state, data.eval_x, data.eval_y)["eval_acc"])
-    )
-    assert acc > 0.9, acc
 
     want = run(base, 2)
     got = run(base.replace(peer_chunk=2), 2)
@@ -171,6 +165,7 @@ def test_validation():
         Config(**CFG, fednova=True, dp_clip=1.0)
 
 
+@pytest.mark.slow  # shares the gated aggregate block the BRB momentum test covers inner
 def test_fednova_brb_gated_matches_plain(mesh8):
     """FedNova under the BRB trust plane: the gated aggregate phase shares
     the same normalization block, so all-verify gated rounds equal plain
@@ -245,6 +240,7 @@ def test_fednova_model_parallel_matches_dense(mesh8, knobs):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
 
 
+@pytest.mark.slow
 def test_fednova_fused_equals_sequential(mesh8):
     """Hetero + FedNova through the fused multi-round scan: the straggler
     schedule keys on the absolute round index, so R fused rounds equal R
@@ -278,3 +274,26 @@ def test_fednova_fused_equals_sequential(mesh8):
         jax.tree.leaves(fused_state.params), jax.tree.leaves(seq_state.params)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+@pytest.mark.slow
+def test_hetero_fednova_learns(mesh8):
+    """Hetero [1,3] + FedNova training converges to accuracy."""
+    base = Config(
+        **{**CFG, "num_peers": 16, "trainers_per_round": 8,
+           "samples_per_peer": 16},
+        hetero_min_epochs=1, fednova=True,
+    )
+    data = make_federated_data(base, eval_samples=256)
+    trainers = jnp.asarray([0, 2, 4, 6, 9, 11, 13, 15], jnp.int32)
+    state = shard_state(init_peer_state(base), base, mesh8)
+    sh = peer_sharding(mesh8)
+    x = jax.device_put(data.x, sh)
+    y = jax.device_put(data.y, sh)
+    fn = build_round_fn(base, mesh8)
+    for r in range(6):
+        state, _ = fn(state, x, y, trainers, jnp.zeros(16), jax.random.PRNGKey(r))
+    acc = float(
+        jnp.mean(build_eval_fn(base)(state, data.eval_x, data.eval_y)["eval_acc"])
+    )
+    assert acc > 0.9, acc

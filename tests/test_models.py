@@ -36,6 +36,7 @@ def test_cnn_works_on_both_input_sizes():
         assert out.shape == (2, 10)
 
 
+@pytest.mark.slow  # heaviest forward; the bench matrix row exercises it e2e
 def test_resnet18_forward():
     model = get_model("resnet18")
     params = init_params(model, (32, 32, 3), jnp.float32, jax.random.PRNGKey(0))
@@ -134,6 +135,7 @@ def test_char_gpt_round_learns(mesh8):
     assert losses[-1] < losses[0] - 0.3, losses
 
 
+@pytest.mark.slow  # kernel-level causal flash==dense tests stay inner
 def test_char_gpt_flash_matches_dense():
     """Model-level causal FLASH attention (the fused Pallas kernels inside
     a decoder-only LM) equals the dense SDPA forward on the same params —

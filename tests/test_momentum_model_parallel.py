@@ -43,7 +43,13 @@ _BASE = dict(
     "knobs",
     [
         {"tp_shards": 2, "vit_heads": 4},
-        {"ep_shards": 2, "moe_experts": 4, "moe_capacity_factor": 4.0},
+        # ep rides the slow tier: the trace placement is the same
+        # derived_tree_specs walk tp exercises; the ep round math keeps
+        # inner coverage in test_expert_parallel.
+        pytest.param(
+            {"ep_shards": 2, "moe_experts": 4, "moe_capacity_factor": 4.0},
+            marks=pytest.mark.slow,
+        ),
         # pp rides the slow tier: its trace placement is the same
         # derived_tree_specs walk tp/ep exercise, and the pp round math
         # keeps inner-loop coverage in test_pipeline_parallel.

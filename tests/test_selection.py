@@ -52,6 +52,7 @@ def test_poc_first_round_falls_back_to_uniform(mesh8):
     np.testing.assert_array_equal(poc.sample_roles(0), uni.sample_roles(0))
 
 
+@pytest.mark.slow  # the exact selection-math tests keep inner coverage
 def test_poc_biases_toward_high_loss_peers_e2e(mesh8):
     """End-to-end on a Dirichlet-skewed shard: after warm-up, PoC selects
     peers whose last loss ranks high — over several rounds the mean loss
