@@ -159,6 +159,14 @@ from p2pdl_tpu.utils.jax_cache import configure_cache
 
 configure_cache()
 
+# On JAX builds missing shard_map/pcast, install the compat aliases —
+# which also turns the cache right back off for this process: XLA:CPU
+# there segfaults deserializing its own shard_map executables, so the
+# cache is only trusted where the real APIs exist.
+from p2pdl_tpu.utils import jax_compat
+
+jax_compat.install()
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -395,6 +403,57 @@ def bench_rounds_per_sec(num_peers: int = 1024, timed_rounds: int = 20) -> tuple
     return bench_config(_headline_cfg(num_peers), timed_rounds=timed_rounds)
 
 
+def _stage_sizes() -> tuple[int, ...]:
+    """Staged-headline peer counts; ``P2PDL_BENCH_STAGES=8,128`` overrides
+    (smoke tests run only the 8-peer stage — full ladder is the default)."""
+    raw = os.environ.get("P2PDL_BENCH_STAGES")
+    if not raw:
+        return (8, 128, 1024)
+    sizes = tuple(int(x) for x in raw.split(",") if x.strip())
+    return sizes or (8, 128, 1024)
+
+
+def telemetry_block() -> dict:
+    """The bench JSON's ``telemetry`` block: BRB message counts and
+    transport byte totals from a host-only trust-plane probe.
+
+    The staged headline exercises the pure data plane (BRB off), so the
+    trust-plane counters would be empty; this probe runs one full BRB
+    round (8 peers, 3 trainers, real ECDSA signing, in-memory hub) on the
+    host — no device work, no compiles — and snapshots the registry the
+    protocol layers wrote into. Counter keys are the registry's canonical
+    ``name{label=value,...}`` series ids.
+    """
+    import hashlib
+
+    from p2pdl_tpu.runtime.driver import _TrustPlane
+    from p2pdl_tpu.utils import telemetry
+
+    cfg = Config(num_peers=8, trainers_per_round=3, byzantine_f=1)
+    trainers = [0, 3, 5]
+    plane = _TrustPlane(cfg)
+    digests = {t: hashlib.sha256(b"bench-probe-%d" % t).digest() for t in trainers}
+    t0 = time.perf_counter()
+    delivered, failed, verified = plane.run_round(0, trainers, digests)
+    wall_s = time.perf_counter() - t0
+    for bc in plane.broadcasters:
+        bc.prune(1)  # flush per-instance delivered/timed_out outcomes
+    brb = telemetry.snapshot("brb.")
+    transport = telemetry.snapshot("transport.")
+    return {
+        "probe": {
+            "peers": cfg.num_peers,
+            "trainers": len(trainers),
+            "peers_delivered": delivered,
+            "trainers_verified": len(verified),
+            "wall_s": round(wall_s, 4),
+        },
+        "brb": brb["counters"],
+        "brb_histograms": brb["histograms"],
+        "transport": transport["counters"],
+    }
+
+
 def run_staged_headline() -> dict:
     """8 -> 128 -> 1024 peers, each written to BENCH_STAGES.json as it
     lands; returns the headline record (largest successful stage).
@@ -410,7 +469,7 @@ def run_staged_headline() -> dict:
         prior = {}
     stages: list[dict] = []
     best = None
-    for peers in (8, 128, 1024):
+    for peers in _stage_sizes():
         name = f"agg_rounds_per_sec_{peers}peers_mlp"
         out, err = _with_retry(lambda p=peers: bench_rounds_per_sec(p), name)
         if out is not None:
@@ -1140,7 +1199,15 @@ def main() -> None:
     if "--tune-flash" in sys.argv:
         run_tune_flash()
         return
-    print(json.dumps(run_staged_headline()))
+    rec = run_staged_headline()
+    # Headline JSON carries the observability block (ISSUE 2): BRB message
+    # counts + transport byte totals from the host-side trust-plane probe.
+    # A probe failure degrades to an error note, never a lost headline.
+    try:
+        rec["telemetry"] = telemetry_block()
+    except Exception as e:  # noqa: BLE001 - headline must still print
+        rec["telemetry"] = {"error": str(e)[:300]}
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

@@ -24,4 +24,5 @@ Architecture (TPU-first, not a port):
 
 __version__ = "0.1.0"
 
+from p2pdl_tpu.utils import jax_compat  # noqa: F401  (P2PDL_JAX_COMPAT=1 installs shard_map/pcast aliases)
 from p2pdl_tpu.config import Config  # noqa: F401

@@ -20,7 +20,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="p2pdl_tpu", description="TPU-native peer-to-peer decentralized learning"
     )
-    p.add_argument("mode", nargs="?", default="run", choices=["run", "serve", "bench"])
+    p.add_argument(
+        "mode", nargs="?", default="run",
+        choices=["run", "serve", "bench", "report"],
+    )
     p.add_argument("--num-peers", type=int, default=8)
     p.add_argument("--trainers-per-round", type=int, default=3)
     p.add_argument("--byzantine-f", type=int, default=1)
@@ -278,7 +281,20 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--attack", default="none", help="Byzantine attack for injected peers")
     p.add_argument("--byz-ids", default="", help="comma-separated adversarial peer ids")
-    p.add_argument("--log-path", default=None, help="JSONL metrics output")
+    p.add_argument(
+        "--log-path", default=None,
+        help="JSONL metrics output (run mode) / input (report mode)",
+    )
+    p.add_argument(
+        "--trace-events", default=None, metavar="PATH",
+        help="capture host control-plane spans and write Chrome trace-event "
+        "JSON here (load in Perfetto / chrome://tracing)",
+    )
+    p.add_argument(
+        "--telemetry-path", default=None, metavar="PATH",
+        help="write the telemetry registry snapshot (counters/gauges/"
+        "histograms JSON) here at exit; report mode reads it back",
+    )
     p.add_argument("--checkpoint-dir", default=None, help="checkpoint/resume directory")
     p.add_argument("--checkpoint-every", type=int, default=1, help="rounds between checkpoints")
     p.add_argument("--profile-dir", default=None, help="jax.profiler trace output dir")
@@ -383,8 +399,130 @@ def _warn(msg: str) -> None:
     print(json.dumps({"warning": msg}), file=sys.stderr)
 
 
+def _md_table(headers: list[str], rows: list[list[str]]) -> list[str]:
+    out = ["| " + " | ".join(headers) + " |"]
+    out.append("|" + "|".join(" --- " for _ in headers) + "|")
+    for row in rows:
+        out.append("| " + " | ".join(row) + " |")
+    return out
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render_report(records: list[dict], telemetry_snapshot: dict | None = None) -> str:
+    """Markdown digest of a metrics JSONL + optional telemetry snapshot.
+
+    Pure host-side rendering: no jax import, so ``report`` runs anywhere
+    the JSONL landed (a laptop, a CI artifact view) without a backend.
+    """
+    lines = ["# p2pdl_tpu run report", ""]
+    rounds = [r for r in records if "round" in r]
+    if rounds:
+        evals = [r for r in rounds if r.get("eval_acc") is not None]
+        durations = [r["duration_s"] for r in rounds if r.get("duration_s")]
+        total_s = sum(durations)
+        # Steady-state throughput excludes the first round (jit compile).
+        steady = durations[1:] if len(durations) > 1 else durations
+        rows = [
+            ["rounds", _fmt(len(rounds))],
+            ["train loss (first -> last)",
+             f"{_fmt(rounds[0].get('train_loss'))} -> {_fmt(rounds[-1].get('train_loss'))}"],
+            ["final eval acc", _fmt(evals[-1]["eval_acc"] if evals else None)],
+            ["best eval acc",
+             _fmt(max(r["eval_acc"] for r in evals) if evals else None)],
+            ["final eval loss", _fmt(evals[-1]["eval_loss"] if evals else None)],
+            ["total wall time (s)", _fmt(total_s)],
+            ["first round (s, incl. compile)",
+             _fmt(durations[0] if durations else None)],
+            ["steady rounds/sec",
+             _fmt(len(steady) / sum(steady) if steady and sum(steady) > 0 else None)],
+        ]
+        lines += ["## Rounds", ""] + _md_table(["metric", "value"], rows) + [""]
+
+        brb_rounds = [r for r in rounds if r.get("brb_delivered") is not None]
+        if brb_rounds:
+            failed: dict[int, int] = {}
+            excluded: dict[int, int] = {}
+            for r in brb_rounds:
+                for p in r.get("brb_failed_peers") or []:
+                    failed[p] = failed.get(p, 0) + 1
+                for t in r.get("brb_excluded_trainers") or []:
+                    excluded[t] = excluded.get(t, 0) + 1
+            rows = [
+                ["rounds with BRB", _fmt(len(brb_rounds))],
+                ["min / mean peers delivered",
+                 f"{min(r['brb_delivered'] for r in brb_rounds)} / "
+                 f"{_fmt(sum(r['brb_delivered'] for r in brb_rounds) / len(brb_rounds))}"],
+                ["peers with delivery failures (id: rounds)",
+                 ", ".join(f"{p}: {n}" for p, n in sorted(failed.items())) or "none"],
+                ["trainers gated out (id: rounds)",
+                 ", ".join(f"{t}: {n}" for t, n in sorted(excluded.items())) or "none"],
+                ["control messages (total)",
+                 _fmt(sum(r.get("control_messages") or 0 for r in brb_rounds))],
+                ["control bytes (total)",
+                 _fmt(sum(r.get("control_bytes") or 0 for r in brb_rounds))],
+            ]
+            lines += ["## Trust plane (BRB)", ""] + _md_table(["metric", "value"], rows) + [""]
+    else:
+        lines += ["_No round records found._", ""]
+
+    if telemetry_snapshot:
+        counters = telemetry_snapshot.get("counters") or {}
+        gauges = telemetry_snapshot.get("gauges") or {}
+        hists = telemetry_snapshot.get("histograms") or {}
+        if counters:
+            lines += ["## Telemetry counters", ""] + _md_table(
+                ["series", "count"],
+                [[k, _fmt(v)] for k, v in counters.items()],
+            ) + [""]
+        if gauges:
+            lines += ["## Telemetry gauges", ""] + _md_table(
+                ["series", "value"],
+                [[k, _fmt(v)] for k, v in gauges.items()],
+            ) + [""]
+        if hists:
+            lines += ["## Telemetry histograms", ""] + _md_table(
+                ["series", "count", "mean", "p50", "p99", "max"],
+                [
+                    [k, _fmt(h.get("count")), _fmt(h.get("mean")),
+                     _fmt(h.get("p50")), _fmt(h.get("p99")), _fmt(h.get("max"))]
+                    for k, h in hists.items()
+                ],
+            ) + [""]
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def run_report(args: argparse.Namespace) -> int:
+    from p2pdl_tpu.utils.metrics import load_results
+
+    if not args.log_path:
+        _warn("report mode needs --log-path pointing at a metrics JSONL")
+        return 2
+    records = load_results(args.log_path)
+    snapshot = None
+    if args.telemetry_path:
+        with open(args.telemetry_path) as f:
+            snapshot = json.load(f)
+    sys.stdout.write(render_report(records, snapshot))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.mode == "report":
+        # Pure host path: no jax/backend init, just JSONL + JSON rendering.
+        return run_report(args)
+    # Every other mode dispatches compiled programs — install the
+    # shard_map/pcast aliases if this JAX build needs them (no-op otherwise).
+    from p2pdl_tpu.utils import jax_compat
+
+    jax_compat.install()
     if args.platform is not None:
         import jax
 
@@ -396,7 +534,20 @@ def main(argv: list[str] | None = None) -> int:
         try:
             jax.config.update("jax_platforms", args.platform)
             if args.platform == "cpu" and args.n_devices is not None:
-                jax.config.update("jax_num_cpu_devices", args.n_devices)
+                try:
+                    jax.config.update("jax_num_cpu_devices", args.n_devices)
+                except AttributeError:
+                    # Older builds lack the config option; their only knob is
+                    # the XLA flag, read from the env at CPU-client init —
+                    # still ahead of us as long as no device was queried.
+                    import os
+
+                    flags = os.environ.get("XLA_FLAGS", "")
+                    if "xla_force_host_platform_device_count" not in flags:
+                        os.environ["XLA_FLAGS"] = (
+                            flags
+                            + f" --xla_force_host_platform_device_count={args.n_devices}"
+                        ).strip()
         except RuntimeError as e:
             _warn(f"--n-devices not applied: {e}")
         if jax.default_backend() != args.platform:
@@ -446,7 +597,10 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     from p2pdl_tpu.runtime.driver import Experiment
+    from p2pdl_tpu.utils import telemetry
 
+    if args.trace_events:
+        telemetry.start_tracing()
     exp = Experiment(
         cfg, attack=args.attack, byz_ids=byz_ids,
         log_path=args.log_path, n_devices=args.n_devices,
@@ -464,7 +618,15 @@ def main(argv: list[str] | None = None) -> int:
                 record = exp.run_round()
                 print(json.dumps(record.to_dict()))
     exp.save_checkpoint()
-    print(json.dumps({"profile": exp.profiler.summary()}))
+    if args.trace_events:
+        telemetry.write_trace(args.trace_events)
+    if args.telemetry_path:
+        with open(args.telemetry_path, "w") as f:
+            json.dump(telemetry.snapshot(), f)
+    print(json.dumps({
+        "profile": exp.profiler.summary(),
+        "telemetry": telemetry.snapshot(),
+    }))
     return 0
 
 

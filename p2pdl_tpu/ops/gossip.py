@@ -67,25 +67,29 @@ def ring_mix(
         right = jnp.concatenate([x[1:], from_next], axis=0)
         return left, right
 
+    # named_scope: labels the mix's ops in jax.profiler device traces, so
+    # the ppermute/ICI cost is attributable next to the host "agg" span.
     if mask is None:
+        with jax.named_scope("gossip.ring_mix"):
+            def leaf(x):
+                left, right = shifted(x)
+                return self_weight * x + side * (left + right)
+
+            return jax.tree.map(leaf, tree)
+
+    with jax.named_scope("gossip.ring_mix_masked"):
+        m = mask.astype(jnp.float32)
+        ml, mr = shifted(m)
+
         def leaf(x):
             left, right = shifted(x)
-            return self_weight * x + side * (left + right)
+            bshape = (x.shape[0],) + (1,) * (x.ndim - 1)
+            wl = (side * ml).reshape(bshape).astype(x.dtype)
+            wr = (side * mr).reshape(bshape).astype(x.dtype)
+            ws = (self_weight + side * ((1.0 - ml) + (1.0 - mr))).reshape(bshape).astype(x.dtype)
+            return ws * x + wl * left + wr * right
 
         return jax.tree.map(leaf, tree)
-
-    m = mask.astype(jnp.float32)
-    ml, mr = shifted(m)
-
-    def leaf(x):
-        left, right = shifted(x)
-        bshape = (x.shape[0],) + (1,) * (x.ndim - 1)
-        wl = (side * ml).reshape(bshape).astype(x.dtype)
-        wr = (side * mr).reshape(bshape).astype(x.dtype)
-        ws = (self_weight + side * ((1.0 - ml) + (1.0 - mr))).reshape(bshape).astype(x.dtype)
-        return ws * x + wl * left + wr * right
-
-    return jax.tree.map(leaf, tree)
 
 
 def _global_shift(x: jnp.ndarray, offset: int, axis_name: str) -> jnp.ndarray:
@@ -172,9 +176,10 @@ def exp_mix(
 
         return branch
 
-    mixed = lax.switch(
-        round_idx % n_strides,
-        [mix_at(2**j) for j in range(n_strides)],
-        leaves,
-    )
+    with jax.named_scope("gossip.exp_mix"):
+        mixed = lax.switch(
+            round_idx % n_strides,
+            [mix_at(2**j) for j in range(n_strides)],
+            leaves,
+        )
     return jax.tree.unflatten(treedef, mixed)

@@ -274,6 +274,18 @@ def bulyan_sharded(
     Alg. 3 second stage) streams through the feature blocks like
     trimmed-mean — the selection mask rides into ``reduce_fn``."""
     from p2pdl_tpu.ops.aggregators import _bulyan_select, closest_to_median_mean
+    from p2pdl_tpu.utils import jax_compat
+
+    if jax_compat.active():
+        # On shimmed builds XLA:CPU's backend aborts (no diagnostic, straight
+        # SIGABRT in backend_compile) on this program's HLO. Every other
+        # sharded reducer compiles fine there; fail loudly instead of
+        # taking down the process.
+        raise NotImplementedError(
+            "bulyan_sharded crashes the XLA:CPU compiler on JAX builds old "
+            "enough to need the p2pdl jax_compat shims; use the gathered "
+            "bulyan path or a newer JAX"
+        )
 
     t = trainer_idx.shape[0]
     if t < 4 * f + 3:

@@ -67,6 +67,7 @@ from p2pdl_tpu.parallel.peer_state import (
     make_optimizer,
     params_layout,
 )
+from p2pdl_tpu.utils import telemetry
 
 
 def _mesh_axes_for(
@@ -756,7 +757,11 @@ def build_round_fn(
     # Donate the state: without it every round copies the full working set
     # (for gossip, num_peers × model) through HBM just to preserve a buffer
     # no caller reads again.
-    return jax.jit(round_fn, donate_argnums=(0,))
+    # traced(): each dispatch (trace/compile on first call, async enqueue
+    # after) shows as a "dispatch.*" span when event tracing is on.
+    return telemetry.traced(
+        "dispatch.round", jax.jit(round_fn, donate_argnums=(0,))
+    )
 
 
 def build_multi_round_fn(
@@ -912,7 +917,9 @@ def build_multi_round_fn(
         )
         return new_state, {"train_loss": losses}
 
-    return jax.jit(multi_round_fn, donate_argnums=(0,))
+    return telemetry.traced(
+        "dispatch.multi_round", jax.jit(multi_round_fn, donate_argnums=(0,))
+    )
 
 
 def build_trust_round_fns(
@@ -1027,7 +1034,12 @@ def build_trust_round_fns(
     # agg_fn consumes the round's transients (deltas + trained opt state) and
     # the previous state — donate all three; train_fn's inputs are all read
     # again by agg_fn, so it donates nothing.
-    return jax.jit(train_fn), jax.jit(agg_fn, donate_argnums=(0, 1, 2))
+    return (
+        telemetry.traced("dispatch.train", jax.jit(train_fn)),
+        telemetry.traced(
+            "dispatch.agg", jax.jit(agg_fn, donate_argnums=(0, 1, 2))
+        ),
+    )
 
 
 def build_gossip_trust_round_fns(
@@ -1116,7 +1128,12 @@ def build_gossip_trust_round_fns(
         )
 
     # mix_fn consumes the round transients and the previous state.
-    return jax.jit(train_fn), jax.jit(mix_fn, donate_argnums=(0, 1, 2))
+    return (
+        telemetry.traced("dispatch.train", jax.jit(train_fn)),
+        telemetry.traced(
+            "dispatch.mix", jax.jit(mix_fn, donate_argnums=(0, 1, 2))
+        ),
+    )
 
 
 def _gossip_body(cfg, mesh, attack, model, opt, l_per_dev, emit_delta=False):
@@ -2033,7 +2050,7 @@ def build_per_peer_eval_fn(cfg: Config, mesh: Mesh) -> Callable:
     def eval_fn(state: PeerState, x, y):
         return smapped(state.params, x, y)
 
-    return eval_fn
+    return telemetry.traced("dispatch.eval_per_peer", eval_fn)
 
 
 def build_personalized_eval_fn(
@@ -2114,7 +2131,7 @@ def build_personalized_eval_fn(
     def eval_fn(state: PeerState, x, y):
         return smapped(state.params, state.rng, x, y)
 
-    return eval_fn
+    return telemetry.traced("dispatch.eval_personalized", eval_fn)
 
 
 def build_eval_fn(cfg: Config) -> Callable:
@@ -2133,4 +2150,4 @@ def build_eval_fn(cfg: Config) -> Callable:
         acc = jnp.mean(jnp.argmax(logits, axis=-1) == eval_y)
         return {"eval_loss": loss, "eval_acc": acc}
 
-    return eval_fn
+    return telemetry.traced("dispatch.eval", eval_fn)

@@ -28,9 +28,11 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import math
+import time
 from typing import Optional
 
 from p2pdl_tpu.protocol import crypto
+from p2pdl_tpu.utils import telemetry
 
 SEND, ECHO, READY = "send", "echo", "ready"
 
@@ -109,8 +111,12 @@ class BRBInstance:
         self.sent_echo = False
         self.sent_ready = False
         self.delivered: Optional[bytes] = None
+        # perf_counter stamp of this peer's own ECHO emission — start of the
+        # echo->deliver latency observation (None until the echo goes out).
+        self._echo_at: Optional[float] = None
 
     def _make(self, kind: str, sender: int, seq: int, digest: bytes, payload=None) -> BRBMessage:
+        telemetry.counter("brb.messages", kind=kind, dir="tx").inc()
         msg = BRBMessage(kind, sender, seq, self.my_id, digest, payload)
         return dataclasses.replace(
             msg, signature=crypto.sign_data(self.private_key, msg.signing_bytes())
@@ -130,12 +136,19 @@ class BRBInstance:
                 # the quorum voted for (payloads dict only admits verified
                 # sha256 matches).
                 self.delivered = self.payloads[digest]
+                telemetry.counter("brb.delivered").inc()
+                if self._echo_at is not None:
+                    telemetry.histogram("brb.echo_to_deliver_seconds").observe(
+                        time.perf_counter() - self._echo_at
+                    )
                 return
 
     def handle(self, msg: BRBMessage) -> list[BRBMessage]:
         """Advance the state machine; returns messages to fan out to all
         peers. Check ``.delivered`` after each call."""
+        telemetry.counter("brb.messages", kind=msg.kind, dir="rx").inc()
         if not crypto_ok(self.key_server, msg):
+            telemetry.counter("brb.signature_failures", kind=msg.kind).inc()
             return []
         out: list[BRBMessage] = []
 
@@ -153,6 +166,7 @@ class BRBInstance:
                 self.accepted_digest = msg.digest
             if self.accepted_digest == msg.digest and not self.sent_echo:
                 self.sent_echo = True
+                self._echo_at = time.perf_counter()
                 out.append(self._make(ECHO, msg.sender, msg.seq, msg.digest))
             # A late SEND can complete a delivery whose READY quorum for this
             # digest already formed (payload was the missing piece).
@@ -239,6 +253,10 @@ class Broadcaster:
 
     def prune(self, before_seq: int) -> None:
         """Evict instances of completed rounds (seq < before_seq) — without
-        this a long experiment leaks one instance per (sender, round)."""
+        this a long experiment leaks one instance per (sender, round).
+        An evicted instance that never delivered is a timed-out broadcast
+        (its round's deadline passed), counted as ``brb.instances{...}``."""
         for key in [k for k in self.instances if k[1] < before_seq]:
+            outcome = "delivered" if self.instances[key].delivered is not None else "timed_out"
+            telemetry.counter("brb.instances", outcome=outcome).inc()
             del self.instances[key]

@@ -12,27 +12,83 @@ digest of the update pytree rather than pickled bytes (the reference signs
 canonical nor safe to deserialize from the network), and there is no
 ``verify_signature_2``-style ``return True`` stub (reference
 ``utils/crypto.py:61-62``).
+
+Dependency gate: when ``cryptography`` is not installed the module falls
+back to HMAC-SHA256 "keypairs" — the private and public halves share one
+random 256-bit secret, sign is an HMAC tag, verify is a constant-time tag
+compare. This preserves every protocol property the simulation exercises
+(unforgeability without the key material, wrong-key rejection, canonical
+digests, KeyServer substitution guard) but is SYMMETRIC — anyone holding
+the "public" half can also sign — so it is simulation-only and the
+serialized form carries a distinct ``P2PDL HMAC`` PEM marker that a real
+PKI would never accept. ``HAVE_CRYPTOGRAPHY`` reports which backend is
+live.
 """
 
 from __future__ import annotations
 
 import hashlib
+import hmac as _hmac
+import os
 import threading
 
 import numpy as np
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
+
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover - exercised only on bare images
+    HAVE_CRYPTOGRAPHY = False
+
+
+_HMAC_PEM_HEADER = b"-----BEGIN P2PDL HMAC-SHA256 KEY-----\n"
+_HMAC_PEM_FOOTER = b"\n-----END P2PDL HMAC-SHA256 KEY-----\n"
+
+
+class _HmacPublicKey:
+    """Fallback 'public' key: shares the signer's secret (symmetric MAC)."""
+
+    __slots__ = ("_secret",)
+
+    def __init__(self, secret: bytes) -> None:
+        self._secret = secret
+
+    def _tag(self, data: bytes) -> bytes:
+        return _hmac.new(self._secret, data, hashlib.sha256).digest()
+
+
+class _HmacPrivateKey:
+    """Fallback private key: HMAC-SHA256 over a random 256-bit secret."""
+
+    __slots__ = ("_secret",)
+
+    def __init__(self, secret: bytes | None = None) -> None:
+        self._secret = secret if secret is not None else os.urandom(32)
+
+    def sign(self, data: bytes) -> bytes:
+        return _hmac.new(self._secret, data, hashlib.sha256).digest()
+
+    def public_key(self) -> _HmacPublicKey:
+        return _HmacPublicKey(self._secret)
 
 
 def generate_key_pair():
-    """ECDSA keypair on SECP256R1 (reference ``utils/crypto.py:42-48``)."""
+    """ECDSA keypair on SECP256R1 (reference ``utils/crypto.py:42-48``);
+    HMAC fallback when ``cryptography`` is unavailable (see module doc)."""
+    if not HAVE_CRYPTOGRAPHY:
+        private_key = _HmacPrivateKey()
+        return private_key, private_key.public_key()
     private_key = ec.generate_private_key(ec.SECP256R1())
     return private_key, private_key.public_key()
 
 
 def sign_data(private_key, data: bytes) -> bytes:
     """ECDSA/SHA-256 signature over ``data`` (reference ``utils/crypto.py:50-59``)."""
+    if isinstance(private_key, _HmacPrivateKey):
+        return private_key.sign(data)
     return private_key.sign(data, ec.ECDSA(hashes.SHA256()))
 
 
@@ -40,6 +96,8 @@ def verify_signature(public_key, signature: bytes, data: bytes) -> bool:
     """True iff ``signature`` is valid for ``data`` (reference
     ``utils/crypto.py:64-101``, minus the KeyServer lookup — see
     :meth:`KeyServer.verify`)."""
+    if isinstance(public_key, _HmacPublicKey):
+        return _hmac.compare_digest(public_key._tag(data), signature)
     try:
         public_key.verify(signature, data, ec.ECDSA(hashes.SHA256()))
         return True
@@ -68,12 +126,17 @@ def digest_update(update) -> bytes:
 
 
 def public_key_pem(public_key) -> bytes:
+    if isinstance(public_key, _HmacPublicKey):
+        return _HMAC_PEM_HEADER + public_key._secret.hex().encode() + _HMAC_PEM_FOOTER
     return public_key.public_bytes(
         serialization.Encoding.PEM, serialization.PublicFormat.SubjectPublicKeyInfo
     )
 
 
 def public_key_from_pem(pem: bytes):
+    if pem.startswith(_HMAC_PEM_HEADER):
+        body = pem[len(_HMAC_PEM_HEADER) : -len(_HMAC_PEM_FOOTER)]
+        return _HmacPublicKey(bytes.fromhex(body.decode()))
     return serialization.load_pem_public_key(pem)
 
 

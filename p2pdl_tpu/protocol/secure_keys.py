@@ -54,15 +54,108 @@ freshness at 1024+ peers.
 from __future__ import annotations
 
 import hashlib
+import hmac as _hmac
+import secrets as _secrets
 
 import numpy as np
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+try:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover - exercised only on bare images
+    HAVE_CRYPTOGRAPHY = False
 
 from p2pdl_tpu.protocol import shamir
 
 _INFO = b"p2pdl-tpu secure-agg v1"
+
+# ---- dependency gate: integer-DH fallback ----------------------------
+# Without ``cryptography`` the keyring swaps P-256 ECDH for classic
+# finite-field Diffie-Hellman over the RFC 3526 group-14 (2048-bit MODP)
+# prime, generator 2, and the HKDF for a single hashlib HMAC
+# extract-and-expand. Commutativity (g^ab == g^ba mod p) gives the same
+# symmetric pair-seed property the protocol pins; scalars stay in
+# [1, P256_ORDER) so Shamir sharing/reconstruction over the P-256 order
+# field is unchanged. Simulation-grade only (no constant-time arithmetic).
+
+_DH_PRIME = int(
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+    "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+    "4FE1356D6D51C245E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3DC2007CB8A163BF05"
+    "98DA48361C55D39A69163FA8FD24CF5F83655D23DCA3AD961C62F356208552BB"
+    "9ED529077096966D670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+    "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9DE2BCBF695581718"
+    "3995497CEA956AE515D2261898FA051015728E5A8AACAA68FFFFFFFFFFFFFFFF",
+    16,
+)
+_DH_GENERATOR = 2
+_DH_BYTES = (_DH_PRIME.bit_length() + 7) // 8
+
+
+class _DhPrivateNumbers:
+    __slots__ = ("private_value",)
+
+    def __init__(self, private_value: int) -> None:
+        self.private_value = private_value
+
+
+class _DhPublicKey:
+    __slots__ = ("y",)
+
+    def __init__(self, y: int) -> None:
+        self.y = y
+
+
+class _DhPrivateKey:
+    """Fallback agreement key mirroring the ``cryptography`` private-key
+    surface this module touches (``public_key``, ``private_numbers``)."""
+
+    __slots__ = ("x", "_pub")
+
+    def __init__(self, x: int) -> None:
+        self.x = x
+        self._pub = _DhPublicKey(pow(_DH_GENERATOR, x, _DH_PRIME))
+
+    def public_key(self) -> _DhPublicKey:
+        return self._pub
+
+    def private_numbers(self) -> _DhPrivateNumbers:
+        return _DhPrivateNumbers(self.x)
+
+
+def generate_agreement_key():
+    """Fresh agreement private key (P-256, or fallback DH) from OS entropy."""
+    if HAVE_CRYPTOGRAPHY:
+        return ec.generate_private_key(ec.SECP256R1())
+    return _DhPrivateKey(_secrets.randbelow(shamir.P256_ORDER - 1) + 1)
+
+
+def derive_agreement_key(scalar: int):
+    """Agreement private key from an explicit scalar in [1, P256_ORDER) —
+    the reconstruction/reproducible-simulation path."""
+    if HAVE_CRYPTOGRAPHY:
+        return ec.derive_private_key(scalar, ec.SECP256R1())
+    return _DhPrivateKey(scalar)
+
+
+def _exchange(priv, pub) -> bytes:
+    if HAVE_CRYPTOGRAPHY:
+        return priv.exchange(ec.ECDH(), pub)
+    return pow(pub.y, priv.x, _DH_PRIME).to_bytes(_DH_BYTES, "big")
+
+
+def _kdf8(shared: bytes, info: bytes) -> bytes:
+    """8 bytes of HKDF-SHA256(shared, info) — library or hashlib-only."""
+    if HAVE_CRYPTOGRAPHY:
+        return HKDF(
+            algorithm=hashes.SHA256(), length=8, salt=None, info=info
+        ).derive(shared)
+    prk = _hmac.new(b"\x00" * 32, shared, hashlib.sha256).digest()
+    return _hmac.new(prk, info + b"\x01", hashlib.sha256).digest()[:8]
 
 
 def ring_committees(num_peers: int, k: int) -> list[list[int]]:
@@ -141,10 +234,10 @@ class SecureAggKeyring:
         self._seed = seed
         self._generation = [0] * num_peers
         if seed is None:
-            self._privs = [ec.generate_private_key(ec.SECP256R1()) for _ in range(num_peers)]
+            self._privs = [generate_agreement_key() for _ in range(num_peers)]
         else:
             self._privs = [
-                ec.derive_private_key(_derive_scalar(seed, i), ec.SECP256R1())
+                derive_agreement_key(_derive_scalar(seed, i))
                 for i in range(num_peers)
             ]
         # The public directory — what a deployment would publish through
@@ -162,13 +255,9 @@ class SecureAggKeyring:
         endpoint would: own private key + the other's public key. Symmetric
         in (i, j) because ECDH is and the HKDF info sorts the ids."""
         lo_id, hi_id = sorted((i, j))
-        shared = priv.exchange(ec.ECDH(), pub)
-        okm = HKDF(
-            algorithm=hashes.SHA256(),
-            length=8,
-            salt=None,
-            info=_INFO + b"|pair|%d|%d" % (lo_id, hi_id),
-        ).derive(shared)
+        okm = _kdf8(
+            _exchange(priv, pub), _INFO + b"|pair|%d|%d" % (lo_id, hi_id)
+        )
         return int.from_bytes(okm[:4], "big"), int.from_bytes(okm[4:], "big")
 
     def pair_seed(self, i: int, j: int) -> tuple[int, int]:
@@ -229,11 +318,10 @@ class SecureAggKeyring:
         else:
             self._generation[peer_id] += 1
         if self._seed is None:
-            priv = ec.generate_private_key(ec.SECP256R1())
+            priv = generate_agreement_key()
         else:
-            priv = ec.derive_private_key(
-                _derive_scalar(self._seed, peer_id, self._generation[peer_id]),
-                ec.SECP256R1(),
+            priv = derive_agreement_key(
+                _derive_scalar(self._seed, peer_id, self._generation[peer_id])
             )
         self._privs[peer_id] = priv
         self.public_keys[peer_id] = priv.public_key()
@@ -310,7 +398,7 @@ class SecureAggKeyring:
             )
         shares = [self.share_of(dropped, h) for h in holders]
         scalar = shamir.reconstruct_secret(shares)
-        priv = ec.derive_private_key(scalar, ec.SECP256R1())
+        priv = derive_agreement_key(scalar)
         row = np.zeros((self.num_peers, 2), np.uint32)
         for j in range(self.num_peers):
             if j == dropped:

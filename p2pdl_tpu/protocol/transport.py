@@ -30,6 +30,7 @@ import threading
 from typing import Callable, Optional
 
 from p2pdl_tpu.protocol.brb import BRBMessage
+from p2pdl_tpu.utils import telemetry
 
 Handler = Callable[[int, bytes], None]  # (src_id, data) -> None
 
@@ -109,6 +110,18 @@ class InMemoryHub:
     hooks inject network faults; ``pump()`` delivers queued messages FIFO
     until quiescence, so protocol cascades (echo storms) run to completion
     deterministically — no threads, no races.
+
+    Accounting contract: ``messages_sent`` counts send *attempts*;
+    ``bytes_sent`` counts only bytes actually enqueued, at their
+    post-corruption length (what the wire would carry — a dropped frame
+    costs no bytes, a corrupted one costs what arrives). Drops and
+    corruptions are tracked separately (``messages_dropped`` /
+    ``bytes_dropped`` / ``messages_corrupted``), and ``pump()`` tracks
+    the delivered side (``messages_delivered`` / ``bytes_delivered``).
+    Every counter mirrors into the telemetry registry under
+    ``transport.messages{transport=hub,...}`` / ``transport.bytes{...}``;
+    registry series are resolved at construction, so ``telemetry.reset()``
+    in tests should precede hub creation.
     """
 
     def __init__(
@@ -122,17 +135,39 @@ class InMemoryHub:
         self.corrupt = corrupt
         self.messages_sent = 0
         self.bytes_sent = 0
+        self.messages_dropped = 0
+        self.bytes_dropped = 0
+        self.messages_corrupted = 0
+        self.messages_delivered = 0
+        self.bytes_delivered = 0
+        self._c_sent = telemetry.counter("transport.messages", transport="hub", event="sent")
+        self._c_bytes = telemetry.counter("transport.bytes", transport="hub", event="sent")
+        self._c_drop = telemetry.counter("transport.messages", transport="hub", event="dropped")
+        self._c_bytes_drop = telemetry.counter("transport.bytes", transport="hub", event="dropped")
+        self._c_corrupt = telemetry.counter("transport.messages", transport="hub", event="corrupted")
+        self._c_deliver = telemetry.counter("transport.messages", transport="hub", event="delivered")
+        self._c_bytes_deliver = telemetry.counter("transport.bytes", transport="hub", event="delivered")
 
     def register(self, peer_id: int, handler: Handler) -> None:
         self._handlers[peer_id] = handler
 
     def send(self, src: int, dst: int, data: bytes) -> None:
         self.messages_sent += 1
-        self.bytes_sent += len(data)
+        self._c_sent.inc()
         if self.drop is not None and self.drop(src, dst, data):
+            self.messages_dropped += 1
+            self.bytes_dropped += len(data)
+            self._c_drop.inc()
+            self._c_bytes_drop.inc(len(data))
             return
         if self.corrupt is not None:
-            data = self.corrupt(src, dst, data)
+            corrupted = self.corrupt(src, dst, data)
+            if corrupted != data:
+                self.messages_corrupted += 1
+                self._c_corrupt.inc()
+            data = corrupted
+        self.bytes_sent += len(data)
+        self._c_bytes.inc(len(data))
         self._queue.append((src, dst, data))
 
     def pump(self, max_messages: int = 1_000_000) -> int:
@@ -144,6 +179,10 @@ class InMemoryHub:
             if handler is not None:
                 handler(src, data)
             delivered += 1
+            self.messages_delivered += 1
+            self.bytes_delivered += len(data)
+            self._c_deliver.inc()
+            self._c_bytes_deliver.inc(len(data))
         return delivered
 
 
@@ -162,6 +201,12 @@ class TCPTransport:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._sock: Optional[socket.socket] = None
+        self._c_sent = telemetry.counter("transport.messages", transport="tcp", event="sent")
+        self._c_bytes = telemetry.counter("transport.bytes", transport="tcp", event="sent")
+        self._c_fail = telemetry.counter("transport.messages", transport="tcp", event="send_failed")
+        self._c_deliver = telemetry.counter("transport.messages", transport="tcp", event="delivered")
+        self._c_bytes_deliver = telemetry.counter("transport.bytes", transport="tcp", event="delivered")
+        self._c_reject = telemetry.counter("transport.messages", transport="tcp", event="rejected")
 
     def add_peer(self, peer_id: int, host: str, port: int) -> None:
         self.peers[peer_id] = (host, port)
@@ -190,19 +235,28 @@ class TCPTransport:
         with conn:
             frame = recv_frame(conn)
             if frame is None or len(frame) < _LEN.size:
+                self._c_reject.inc()  # malformed/oversize/truncated frame
                 return
             (src,) = _LEN.unpack(frame[: _LEN.size])
+            self._c_deliver.inc()
+            self._c_bytes_deliver.inc(len(frame) - _LEN.size)
             self.handler(src, frame[_LEN.size :])
 
     def send(self, dst: int, data: bytes) -> bool:
         addr = self.peers.get(dst)
         if addr is None:
+            self._c_fail.inc()
             return False
         try:
+            # Fresh connection per frame: a refused/reset connection is the
+            # reconnect-failure signal this counter pair captures.
             with socket.create_connection(addr, timeout=5.0) as s:
                 send_frame(s, _LEN.pack(self.my_id) + data)
+            self._c_sent.inc()
+            self._c_bytes.inc(len(data))
             return True
         except OSError:
+            self._c_fail.inc()
             return False
 
     def stop(self) -> None:

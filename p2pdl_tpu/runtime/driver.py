@@ -43,6 +43,7 @@ from p2pdl_tpu.parallel import (
 from p2pdl_tpu.protocol.brb import BRBConfig, Broadcaster
 from p2pdl_tpu.protocol.crypto import KeyServer, digest_update, generate_key_pair
 from p2pdl_tpu.protocol.transport import InMemoryHub, brb_from_wire, brb_to_wire
+from p2pdl_tpu.utils import telemetry
 from p2pdl_tpu.utils.metrics import MetricsLogger
 from p2pdl_tpu.utils.profiling import Profiler
 
@@ -424,6 +425,13 @@ class Experiment:
         excluded = sorted(set(live.tolist()) - set(verified))
         msgs = self.trust.hub.messages_sent - m0
         nbytes = self.trust.hub.bytes_sent - b0
+        telemetry.gauge("driver.live_peers").set(delivered)
+        # Per-peer failure counters: a peer that keeps missing deliveries
+        # across rounds shows up as a hot series, not a scalar average.
+        for pid in failed:
+            telemetry.counter("driver.brb_delivery_failures", peer=pid).inc()
+        for tid in excluded:
+            telemetry.counter("driver.brb_excluded_trainers", trainer=tid).inc()
         if self.failure_cooldown_rounds > 0:
             for pid in failed + excluded:
                 self._suspect_until[pid] = r + self.failure_cooldown_rounds
@@ -505,14 +513,17 @@ class Experiment:
                     self._seed_mat = self.secure_keyring.seed_matrix()
                 self._pair_seeds_dev = jnp.asarray(self._seed_mat)
             # BRB-gated pipeline: train -> digest+BRB -> gated aggregate.
-            with self.profiler.phase("round"):
+            with self.profiler.phase("round", round=r, trainers=len(live)):
                 delta, new_opt, losses_dev = self.train_fn(
                     self.state, self.x, self.y, self.byz_gate, mask_key
                 )
                 self._peer_losses = np.asarray(losses_dev)  # [P]
                 losses = self._peer_losses[live]
                 train_loss = float(np.mean(losses))
-            with self.profiler.phase("brb"):
+            with self.profiler.phase(
+                "brb", round=r, trainers=len(live),
+                committee=len(self.trust.committee),
+            ):
                 brb_delivered, brb_failed, brb_excluded, verified, msgs, nbytes = (
                     self._run_trust_plane(r, live, delta)
                 )
@@ -530,7 +541,7 @@ class Experiment:
                     # Byzantine updates by construction); delivery failures
                     # remain observational -> next-round sampling exclusion.
                     gated = trainers
-            with self.profiler.phase("agg"):
+            with self.profiler.phase("agg", round=r):
                 # masked_idx = the PRE-gate trainer vector: under
                 # secure_fedavg every sampled trainer masked its delta
                 # before the BRB verdict landed, so the aggregate must
@@ -570,12 +581,15 @@ class Experiment:
             # peer's weight is zeroed in every neighbor's mixing row, so its
             # (possibly corrupted) params never enter any honest peer's
             # round-r mix — exclusion is in-round, not one round late.
-            with self.profiler.phase("round"):
+            with self.profiler.phase("round", round=r, trainers=self.cfg.num_peers):
                 attacked, new_opt, losses_dev, delta = self.train_fn(
                     self.state, self.x, self.y, self.byz_gate, mask_key
                 )
                 train_loss = float(np.mean(np.asarray(losses_dev)))
-            with self.profiler.phase("brb"):
+            with self.profiler.phase(
+                "brb", round=r, trainers=self.cfg.num_peers,
+                committee=len(self.trust.committee),
+            ):
                 # Gossip has no roles: EVERY peer mixes, so every peer must
                 # commit its delta — the verdict covers the full peer set
                 # (a peer outside the committee would otherwise be
@@ -587,12 +601,12 @@ class Experiment:
                 verdict = np.isin(
                     gossip_live, np.asarray(verified)
                 ).astype(np.float32)
-            with self.profiler.phase("agg"):
+            with self.profiler.phase("agg", round=r):
                 self.state = self.mix_fn(
                     self.state, attacked, new_opt, jnp.asarray(verdict)
                 )
         else:
-            with self.profiler.phase("round"):
+            with self.profiler.phase("round", round=r, trainers=len(live)):
                 self.state, m = self.round_fn(
                     self.state,
                     self.x,
@@ -612,7 +626,7 @@ class Experiment:
                     losses = losses[live]
                 train_loss = float(np.mean(losses))
 
-        with self.profiler.phase("eval"):
+        with self.profiler.phase("eval", round=r):
             ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)
         record = RoundRecord(
             round=r,
@@ -628,6 +642,15 @@ class Experiment:
             control_bytes=nbytes,
             dp_epsilon=self._dp_epsilon(r + 1),
         )
+        # Compile/steady split: this PROCESS's first round pays jit tracing
+        # + XLA compilation (whatever round index a resumed run starts at);
+        # every later round is steady-state. Splitting the series keeps the
+        # compile spike out of the throughput percentiles.
+        if not getattr(self, "_first_round_done", False):
+            self._first_round_done = True
+            telemetry.gauge("driver.first_round_s").set(record.duration_s)
+        else:
+            telemetry.histogram("driver.steady_round_s").observe(record.duration_s)
         self.records.append(record)
         self.metrics.log(record.to_dict())
         if self.checkpointer is not None and (r + 1) % self.checkpoint_every == 0:
@@ -700,7 +723,7 @@ class Experiment:
             block = min(rounds_per_call, self.cfg.rounds - r0)
             trainer_mat = np.stack([self.sample_roles(r0 + i) for i in range(block)])
             t0 = time.perf_counter()
-            with self.profiler.phase("round"):
+            with self.profiler.phase("round", round=r0, rounds=block):
                 self.state, m = self._multi_round_fn(
                     self.state,
                     self.x,
@@ -712,7 +735,12 @@ class Experiment:
                 losses = np.asarray(m["train_loss"])  # [R, P]
                 self._peer_losses = losses[-1]  # feeds biased selection
             dt = (time.perf_counter() - t0) / block
-            with self.profiler.phase("eval"):
+            if not getattr(self, "_first_round_done", False):
+                self._first_round_done = True
+                telemetry.gauge("driver.first_round_s").set(dt * block)
+            else:
+                telemetry.histogram("driver.steady_round_s").observe(dt)
+            with self.profiler.phase("eval", round=r0 + block - 1):
                 ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)
             for i in range(block):
                 live = trainer_mat[i][trainer_mat[i] >= 0]

@@ -1,5 +1,6 @@
-"""Host utilities: metrics logging, checkpointing, profiling."""
+"""Host utilities: metrics logging, checkpointing, profiling, telemetry."""
 
-from p2pdl_tpu.utils.metrics import MetricsLogger, save_results
+from p2pdl_tpu.utils import telemetry
+from p2pdl_tpu.utils.metrics import MetricsLogger, load_results, save_results
 
-__all__ = ["MetricsLogger", "save_results"]
+__all__ = ["MetricsLogger", "load_results", "save_results", "telemetry"]

@@ -1,0 +1,150 @@
+"""Compatibility aliases for older JAX builds (additive, opt-in).
+
+The data plane is written against the modern public API: ``jax.shard_map``
+and ``jax.lax.pcast`` (replication-type casts). Some deployed builds (e.g.
+0.4.37) predate both promotions but ship the same machinery as
+``jax.experimental.shard_map``. ``install()`` adds the missing attributes
+ON THOSE BUILDS ONLY:
+
+- ``jax.shard_map`` -> ``jax.experimental.shard_map.shard_map`` with
+  ``check_rep=False``: the old replication checker predates the ``pcast``
+  type system the code relies on, so it must be off — sharding semantics
+  and numerics are unchanged (``check_rep`` only gates a static analysis).
+- ``jax.lax.pcast`` -> identity. ``pcast`` adjusts the *replication type*
+  of a value (invariant <-> varying) for that same checker and is a no-op
+  on the actual data; with the checker off, identity is exact.
+- ``jax.lax.axis_size`` -> ``jax.core.axis_frame``, which on these builds
+  resolves an axis name straight to its (static) size.
+
+When any alias is installed, the persistent compilation cache is also
+disabled for the process: on these builds XLA:CPU segfaults
+*deserializing* its own just-serialized shard_map executables (observed
+on 0.4.37 — a cache write followed by a cache hit in the same process
+crashes the interpreter), so compiled-program caching is only safe where
+the real APIs exist.
+
+Opt-in, not automatic: the CLI and bench entry points call ``install()``
+before building any compiled program; everything else (notably the test
+suite, whose budget assumes seed-era behavior) gets it only with
+``P2PDL_JAX_COMPAT=1``.
+"""
+
+from __future__ import annotations
+
+import os
+
+_ENV = "P2PDL_JAX_COMPAT"
+
+_active = False
+
+
+def active() -> bool:
+    """True if ``install()`` actually installed any alias in this process —
+    i.e. we are running on compat shims rather than the real APIs."""
+    return _active
+
+
+def install() -> bool:
+    """Install whichever aliases this build is missing; returns True if any
+    were installed (i.e. the process is running on compat shims). Idempotent;
+    a no-op returning False on builds with the real APIs."""
+    import jax
+
+    installed = False
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+        def _shard_map_compat(f, mesh=None, in_specs=None, out_specs=None, **kwargs):
+            kwargs.setdefault("check_rep", False)
+            return _experimental_shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+            )
+
+        jax.shard_map = _shard_map_compat
+        installed = True
+
+    if not hasattr(jax.lax, "axis_size"):
+        from jax._src import core as _jax_core
+
+        def _axis_size_compat(axis_name):
+            # axis_frame(name) on these builds resolves straight to the size
+            if isinstance(axis_name, (tuple, list)):
+                out = 1
+                for n in axis_name:
+                    out *= _jax_core.axis_frame(n)
+                return out
+            return _jax_core.axis_frame(axis_name)
+
+        jax.lax.axis_size = _axis_size_compat
+        installed = True
+
+    if not hasattr(jax, "typeof"):
+        from jax._src import core as _jc
+
+        class _TypeofCompat:
+            """Aval view carrying an empty ``vma`` set. ``vma`` (varying
+            manual axes) exists only to compute pcast/pvary targets; with
+            those identity-aliased, "varying over nothing" is the one
+            consistent answer."""
+
+            __slots__ = ("_aval", "vma")
+
+            def __init__(self, aval):
+                self._aval = aval
+                self.vma = frozenset()
+
+            def __getattr__(self, name):
+                return getattr(self._aval, name)
+
+        jax.typeof = lambda x: _TypeofCompat(_jc.get_aval(x))
+        installed = True
+
+    if not hasattr(jax.lax, "pcast"):
+
+        def _pcast_compat(x, axis_name, *, to=None):
+            del axis_name, to  # replication-type cast only; data is unchanged
+            return x
+
+        jax.lax.pcast = _pcast_compat
+        installed = True
+
+    try:
+        jax.ShapeDtypeStruct((1,), "float32", vma=frozenset())
+    except TypeError:
+        _orig_sds = jax.ShapeDtypeStruct
+
+        class _SDSCompat(_orig_sds):
+            """Must stay a real subclass: pallas matches ``case
+            jax.ShapeDtypeStruct():`` structurally, so a plain factory
+            function breaks it."""
+
+            def __init__(self, shape, dtype, *args, **kwargs):
+                kwargs.pop("vma", None)  # replication type; meaningless pre-vma
+                super().__init__(shape, dtype, *args, **kwargs)
+
+        jax.ShapeDtypeStruct = _SDSCompat
+        installed = True
+
+    try:
+        from jax.experimental.pallas import tpu as _pltpu
+
+        if not hasattr(_pltpu, "CompilerParams") and hasattr(
+            _pltpu, "TPUCompilerParams"
+        ):
+            # pure rename: TPUCompilerParams became CompilerParams
+            _pltpu.CompilerParams = _pltpu.TPUCompilerParams
+            installed = True
+    except ImportError:  # pragma: no cover - no pallas on this build
+        pass
+
+    if installed:
+        global _active
+        _active = True
+        jax.config.update("jax_enable_compilation_cache", False)
+
+    return installed
+
+
+if os.environ.get(_ENV, "").lower() in ("1", "on", "true"):
+    install()

@@ -15,30 +15,82 @@ from typing import Any, Optional
 
 
 class MetricsLogger:
+    """JSONL appender with an explicit flush contract.
+
+    The file is opened once in append mode and held for the logger's
+    lifetime (the old open-per-record pattern paid an open/close syscall
+    pair per round and could interleave partial lines under concurrent
+    appenders). ``log()`` writes one complete line and flushes it, so a
+    record is either fully on disk after ``log()`` returns or not written
+    at all — the invariant ``load_results`` relies on for everything but
+    the final line of a killed run. ``close()`` (or use as a context
+    manager) releases the handle; logging after close reopens lazily.
+    """
+
     def __init__(self, path: Optional[str] = None) -> None:
         self.path = path
         self.records: list[dict[str, Any]] = []
+        self._fh = None
         if path:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
 
     def log(self, record: dict[str, Any]) -> None:
         self.records.append(record)
         if self.path:
-            with open(self.path, "a") as f:
-                f.write(json.dumps(record) + "\n")
+            if self._fh is None:
+                self._fh = open(self.path, "a")
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
+
+    def flush(self) -> None:
+        if self._fh is not None:
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def save_results(result_data: dict[str, Any], result_file: str) -> None:
     """Append one result record to a JSONL file (reference
     ``utils/log.py:4-21`` parity, minus its corrupt-file JSON-array rewrite)."""
-    MetricsLogger(result_file).log(result_data)
+    with MetricsLogger(result_file) as logger:
+        logger.log(result_data)
 
 
 def load_results(result_file: str) -> list[dict[str, Any]]:
-    out = []
+    """Parse a JSONL results file, tolerating a truncated FINAL line.
+
+    A run killed mid-append leaves at most one partial record, and only at
+    the tail (``log()`` flushes whole lines). That trailing fragment is
+    dropped silently; a malformed line anywhere *before* the last one is
+    real corruption and still raises ``json.JSONDecodeError``.
+    """
+    lines = []
     with open(result_file) as f:
         for line in f:
             line = line.strip()
             if line:
-                out.append(json.loads(line))
+                lines.append(line)
+    out: list[dict[str, Any]] = []
+    for i, line in enumerate(lines):
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                break  # partial write from a killed run
+            raise
     return out

@@ -1,0 +1,485 @@
+"""Unified telemetry plane: counters, histograms, and event-trace spans.
+
+The reference has zero structured observability — its only signal is loss
+``logging`` and a results writer that is imported but never called
+(reference ``utils/log.py:4-21``; SURVEY §5 "tracing/profiling: ABSENT").
+``utils/profiling.py`` resurrected per-phase wall timers and ``jax.profiler``
+device traces; this module is the third leg: a process-wide **metrics
+registry** (Counter / Gauge / Histogram with labeled series) that the trust
+plane (BRB message mix, signature failures, delivery latency), the
+transports (frames/bytes sent vs. delivered vs. dropped vs. corrupted), and
+the driver (per-round spans, compile-vs-steady-state split) all write into —
+plus a **span tracer** that emits Chrome trace-event JSON, loadable directly
+in Perfetto / ``chrome://tracing`` next to the ``jax.profiler`` device
+traces (host control-plane spans above, device ops below).
+
+Cost model (deliberate):
+
+- The registry is ON by default — increments are a dict lookup and an int
+  add on the host control plane, orders of magnitude below the ECDSA
+  signing and device dispatches they sit next to. ``set_enabled(False)``
+  (or ``P2PDL_TELEMETRY=0``) swaps every accessor to shared no-op
+  singletons for a measurably-zero path.
+- The tracer is OFF by default — span capture allocates one event dict per
+  span, so it is opt-in (``start_tracing()`` / CLI ``--trace-events``).
+  While off, ``span()`` returns one shared null context: no allocation,
+  no clock read.
+
+Registry series are keyed ``name{label=value,...}`` with sorted labels, the
+Prometheus exposition convention, so ``snapshot()`` output diffs cleanly
+across runs and greps predictably in bench/CLI artifacts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Iterator, Optional
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SpanTracer",
+    "counter",
+    "gauge",
+    "histogram",
+    "registry",
+    "tracer",
+    "span",
+    "instant",
+    "traced",
+    "enabled",
+    "set_enabled",
+    "start_tracing",
+    "stop_tracing",
+    "tracing",
+    "write_trace",
+    "snapshot",
+    "reset",
+    "series_key",
+]
+
+
+def series_key(name: str, labels: dict[str, Any]) -> str:
+    """Canonical series id: ``name`` or ``name{k=v,...}`` with sorted keys."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonic event count. ``inc`` is the whole API — no decrements, so a
+    snapshot diff between two points is always the events in between."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+    def to_value(self) -> int:
+        return self.value
+
+
+class Gauge:
+    """Last-written value (e.g. first-round compile seconds, live peers)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def to_value(self) -> float:
+        return self.value
+
+
+# Geometric bucket ladder from 1us to ~18min: wide enough for control-plane
+# latencies (sub-ms) and whole-round durations (seconds) in one scheme.
+DEFAULT_BUCKETS: tuple[float, ...] = tuple(1e-6 * 4.0**i for i in range(16))
+
+
+class Histogram:
+    """Fixed-bucket histogram with exact count/sum/min/max.
+
+    Buckets hold cumulative-style counts per bound (``bounds[i]`` counts
+    observations ``<= bounds[i]`` and ``> bounds[i-1]``); values above the
+    last bound land in the overflow slot. Quantiles are estimated by linear
+    interpolation inside the winning bucket — good to a bucket width, which
+    is what a fixed-memory histogram can honestly claim.
+    """
+
+    __slots__ = ("bounds", "buckets", "count", "sum", "min", "max")
+
+    def __init__(self, bounds: tuple[float, ...] = DEFAULT_BUCKETS) -> None:
+        self.bounds = bounds
+        self.buckets = [0] * (len(bounds) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        lo, hi = 0, len(self.bounds)
+        while lo < hi:  # bisect over the (sorted) bounds
+            mid = (lo + hi) // 2
+            if v <= self.bounds[mid]:
+                hi = mid
+            else:
+                lo = mid + 1
+        self.buckets[lo] += 1
+
+    def quantile(self, q: float) -> float:
+        """Estimated ``q``-quantile (0..1); exact min/max at the ends."""
+        if self.count == 0:
+            return 0.0
+        if q <= 0.0:
+            return self.min
+        if q >= 1.0:
+            return self.max
+        target = q * self.count
+        seen = 0.0
+        for i, c in enumerate(self.buckets):
+            if c == 0:
+                continue
+            if seen + c >= target:
+                lo = self.bounds[i - 1] if i > 0 else min(self.min, self.bounds[0])
+                hi = self.bounds[i] if i < len(self.bounds) else self.max
+                lo = max(lo, self.min)
+                hi = min(hi, self.max)
+                frac = (target - seen) / c
+                return lo + frac * (hi - lo)
+            seen += c
+        return self.max
+
+    def to_value(self) -> dict[str, Any]:
+        if self.count == 0:
+            return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0, "mean": 0.0}
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "mean": self.sum / self.count,
+            "p50": self.quantile(0.50),
+            "p90": self.quantile(0.90),
+            "p99": self.quantile(0.99),
+        }
+
+
+class _NoopMetric:
+    """Shared do-nothing stand-in returned by every accessor while the
+    registry is disabled — callers never branch, they just hit this sink."""
+
+    __slots__ = ()
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+
+_NOOP = _NoopMetric()
+
+
+class MetricsRegistry:
+    """Process-wide labeled metric series.
+
+    ``counter/gauge/histogram(name, **labels)`` create-or-fetch the series;
+    creation takes a lock (TCP transport handlers run on threads), the
+    returned object is then incremented lock-free — int ops under the GIL
+    are the documented best-effort concurrency contract, the same one the
+    hub's inline attributes always had.
+    """
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def _series(self, table: dict, cls, key: str, *args):
+        metric = table.get(key)
+        if metric is None:
+            with self._lock:
+                metric = table.get(key)
+                if metric is None:
+                    metric = cls(*args)
+                    table[key] = metric
+        return metric
+
+    def counter(self, name: str, **labels: Any) -> Counter:
+        if not self.enabled:
+            return _NOOP  # type: ignore[return-value]
+        return self._series(self._counters, Counter, series_key(name, labels))
+
+    def gauge(self, name: str, **labels: Any) -> Gauge:
+        if not self.enabled:
+            return _NOOP  # type: ignore[return-value]
+        return self._series(self._gauges, Gauge, series_key(name, labels))
+
+    def histogram(
+        self,
+        name: str,
+        bounds: tuple[float, ...] = DEFAULT_BUCKETS,
+        **labels: Any,
+    ) -> Histogram:
+        if not self.enabled:
+            return _NOOP  # type: ignore[return-value]
+        return self._series(
+            self._histograms, Histogram, series_key(name, labels), bounds
+        )
+
+    def snapshot(self, prefix: str = "") -> dict[str, dict[str, Any]]:
+        """JSON-ready dump ``{counters, gauges, histograms}``; ``prefix``
+        filters series by name (e.g. ``"brb."``)."""
+        with self._lock:
+            return {
+                "counters": {
+                    k: m.to_value()
+                    for k, m in sorted(self._counters.items())
+                    if k.startswith(prefix)
+                },
+                "gauges": {
+                    k: m.to_value()
+                    for k, m in sorted(self._gauges.items())
+                    if k.startswith(prefix)
+                },
+                "histograms": {
+                    k: m.to_value()
+                    for k, m in sorted(self._histograms.items())
+                    if k.startswith(prefix)
+                },
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+class _Span:
+    """One open span; emits a Chrome complete event ("ph": "X") on exit."""
+
+    __slots__ = ("_tracer", "_name", "_args", "_t0")
+
+    def __init__(self, tracer: "SpanTracer", name: str, args: dict) -> None:
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        t1 = time.perf_counter_ns()
+        self._tracer._emit(self._name, self._t0, t1 - self._t0, self._args)
+
+
+class _NullContext:
+    """Shared no-clock, no-allocation context for the tracing-off path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NULL_CONTEXT = _NullContext()
+
+
+class SpanTracer:
+    """Span recorder emitting the Chrome trace-event JSON object format.
+
+    The output (``write()``) is ``{"traceEvents": [...]}`` with complete
+    ("X") duration events in microseconds — the format Perfetto and
+    ``chrome://tracing`` load natively, and the same timeline family as the
+    ``jax.profiler`` device traces, so host control-plane spans and device
+    op traces can be inspected side by side.
+    """
+
+    def __init__(self, enabled: bool = False) -> None:
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._events: list[dict[str, Any]] = []
+        self._pid = os.getpid()
+
+    def span(self, name: str, **args: Any):
+        if not self.enabled:
+            return _NULL_CONTEXT
+        return _Span(self, name, args)
+
+    def instant(self, name: str, **args: Any) -> None:
+        """Zero-duration marker event (Chrome "i" phase)."""
+        if not self.enabled:
+            return
+        ev = {
+            "name": name,
+            "ph": "i",
+            "ts": time.perf_counter_ns() / 1e3,
+            "pid": self._pid,
+            "tid": threading.get_ident() & 0xFFFF,
+            "s": "t",
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def _emit(self, name: str, t0_ns: int, dur_ns: int, args: dict) -> None:
+        ev = {
+            "name": name,
+            "ph": "X",
+            "ts": t0_ns / 1e3,  # Chrome trace timestamps are microseconds
+            "dur": dur_ns / 1e3,
+            "pid": self._pid,
+            "tid": threading.get_ident() & 0xFFFF,
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "traceEvents": [
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": self._pid,
+                    "args": {"name": "p2pdl_tpu host control plane"},
+                }
+            ]
+            + self.events(),
+            "displayTimeUnit": "ms",
+        }
+
+    def write(self, path: str) -> None:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_json(), f)
+        os.replace(tmp, path)
+
+
+# ---- Process-wide default instances ----------------------------------------
+
+_REGISTRY = MetricsRegistry(
+    enabled=os.environ.get("P2PDL_TELEMETRY", "1") not in ("0", "off", "false")
+)
+_TRACER = SpanTracer(enabled=False)
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def tracer() -> SpanTracer:
+    return _TRACER
+
+
+def counter(name: str, **labels: Any) -> Counter:
+    return _REGISTRY.counter(name, **labels)
+
+
+def gauge(name: str, **labels: Any) -> Gauge:
+    return _REGISTRY.gauge(name, **labels)
+
+
+def histogram(name: str, **labels: Any) -> Histogram:
+    return _REGISTRY.histogram(name, **labels)
+
+
+def span(name: str, **args: Any):
+    return _TRACER.span(name, **args)
+
+
+def instant(name: str, **args: Any) -> None:
+    _TRACER.instant(name, **args)
+
+
+def enabled() -> bool:
+    return _REGISTRY.enabled
+
+
+def set_enabled(on: bool) -> None:
+    """Flip the registry's no-op path (spans are governed by ``tracing``)."""
+    _REGISTRY.enabled = on
+
+
+def tracing() -> bool:
+    return _TRACER.enabled
+
+
+def start_tracing() -> None:
+    _TRACER.enabled = True
+
+
+def stop_tracing() -> None:
+    _TRACER.enabled = False
+
+
+def write_trace(path: str) -> None:
+    _TRACER.write(path)
+
+
+def snapshot(prefix: str = "") -> dict[str, dict[str, Any]]:
+    return _REGISTRY.snapshot(prefix)
+
+
+def reset() -> None:
+    """Clear every series and recorded span (test isolation)."""
+    _REGISTRY.reset()
+    _TRACER.clear()
+
+
+def traced(name: str, fn, **args: Any):
+    """Wrap a callable so each invocation runs under ``span(name)`` — the
+    dispatch-site annotation for compiled programs (``parallel/round.py``
+    wraps its jitted fns; the span then measures host dispatch + any
+    blocking the caller does inside). Tracing off = one predicate check."""
+
+    def wrapper(*a, **k):
+        if not _TRACER.enabled:
+            return fn(*a, **k)
+        with _TRACER.span(name, **args):
+            return fn(*a, **k)
+
+    wrapper.__name__ = f"traced_{getattr(fn, '__name__', name)}"
+    wrapper.__wrapped__ = fn
+    return wrapper

@@ -1,0 +1,131 @@
+"""End-to-end smoke tests for the observability surfaces.
+
+Pins two contracts consumers script against:
+
+- ``bench.py`` emits exactly ONE line on stdout — the final JSON record —
+  and that record carries a ``telemetry`` block with BRB message counts
+  and transport byte totals (everything else goes to stderr).
+- ``cli.py report`` turns a metrics JSONL (+ optional telemetry snapshot)
+  into a Markdown digest without touching jax or a device.
+
+Both run as subprocesses so they exercise the real entrypoints, env
+handling and stdout/stderr split — not an in-process approximation.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(argv, tmp_path, extra_env=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.update(extra_env or {})
+    return subprocess.run(
+        argv,
+        cwd=str(tmp_path),  # a clean cwd: artifacts must not land in the repo
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+
+
+def test_bench_stdout_is_single_json_line_with_telemetry(tmp_path):
+    proc = _run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        tmp_path,
+        extra_env={"P2PDL_BENCH_SKIP_PROBE": "1", "P2PDL_BENCH_STAGES": "8"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be exactly one JSON line, got: {lines}"
+    rec = json.loads(lines[0])
+    tele = rec["telemetry"]
+    assert "error" not in tele, tele
+    # BRB message counts: a full trust round delivered to all 8 peers
+    assert tele["probe"]["peers_delivered"] == tele["probe"]["peers"] == 8
+    brb = tele["brb"]
+    assert brb["brb.messages{dir=rx,kind=send}"] > 0
+    assert brb["brb.messages{dir=rx,kind=echo}"] > 0
+    assert brb["brb.delivered"] > 0
+    # Transport byte totals balance: nothing dropped, so sent == delivered
+    tp = tele["transport"]
+    assert tp["transport.bytes{event=sent,transport=hub}"] > 0
+    assert (
+        tp["transport.bytes{event=delivered,transport=hub}"]
+        == tp["transport.bytes{event=sent,transport=hub}"]
+    )
+
+
+def test_cli_report_end_to_end(tmp_path):
+    log_path = tmp_path / "metrics.jsonl"
+    records = [
+        {
+            "round": r,
+            "trainers": [0, 1],
+            "train_loss": 2.5 - 0.1 * r,
+            "eval_loss": 2.4 - 0.05 * r,
+            "eval_acc": 0.1 + 0.05 * r,
+            "duration_s": 1.0 if r == 0 else 0.1,
+            "brb_delivered": 4,
+            "brb_failed_peers": [3] if r == 1 else [],
+            "brb_excluded_trainers": [],
+            "control_messages": 100,
+            "control_bytes": 5000,
+        }
+        for r in range(3)
+    ]
+    log_path.write_text("".join(json.dumps(r) + "\n" for r in records))
+    telemetry_path = tmp_path / "telemetry.json"
+    telemetry_path.write_text(
+        json.dumps(
+            {
+                "counters": {"brb.delivered": 12},
+                "gauges": {"driver.first_round_s": 1.0},
+                "histograms": {
+                    "driver.steady_round_s": {
+                        "count": 2,
+                        "sum": 0.2,
+                        "min": 0.1,
+                        "max": 0.1,
+                        "mean": 0.1,
+                        "p50": 0.1,
+                        "p90": 0.1,
+                        "p99": 0.1,
+                    }
+                },
+            }
+        )
+    )
+    proc = _run(
+        [
+            sys.executable,
+            "-m",
+            "p2pdl_tpu.cli",
+            "report",
+            "--log-path",
+            str(log_path),
+            "--telemetry-path",
+            str(telemetry_path),
+        ],
+        tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    assert "# p2pdl_tpu run report" in out
+    assert "## Rounds" in out
+    assert "## Trust plane (BRB)" in out
+    assert "3" in out  # rounds count
+    assert "3: 1" in out  # peer 3 failed in 1 round
+    assert "## Telemetry counters" in out
+    assert "brb.delivered" in out
+    assert "driver.steady_round_s" in out
+
+
+def test_cli_report_without_log_path_fails_cleanly(tmp_path):
+    proc = _run([sys.executable, "-m", "p2pdl_tpu.cli", "report"], tmp_path)
+    assert proc.returncode == 2
+    assert proc.stdout.strip() == ""

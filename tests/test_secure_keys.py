@@ -127,9 +127,9 @@ def test_rotate_restores_forward_secrecy():
     # Old shares are stale: they reconstruct a scalar whose seeds are the
     # OLD ones, not the rotated ones.
     from p2pdl_tpu.protocol import shamir as _sh
-    from cryptography.hazmat.primitives.asymmetric import ec as _ec
+    from p2pdl_tpu.protocol.secure_keys import derive_agreement_key
     old_scalar = _sh.reconstruct_secret(old_shares[:4])
-    old_priv = _ec.derive_private_key(old_scalar, _ec.SECP256R1())
+    old_priv = derive_agreement_key(old_scalar)
     stale = SecureAggKeyring.pair_seed_from(old_priv, kr.public_keys[3], 2, 3)
     assert tuple(mat[2, 3]) != stale
     # Fresh shares reconstruct the NEW row.

@@ -1,0 +1,274 @@
+"""Unit tests for the telemetry plane (utils/telemetry.py) and the phase
+profiler (utils/profiling.py) it integrates with."""
+
+import json
+import time
+
+import pytest
+
+from p2pdl_tpu.utils import telemetry
+from p2pdl_tpu.utils.metrics import MetricsLogger, load_results
+from p2pdl_tpu.utils.profiling import PhaseStats, Profiler
+from p2pdl_tpu.utils.telemetry import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    SpanTracer,
+    series_key,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    was_enabled = telemetry.enabled()
+    was_tracing = telemetry.tracing()
+    yield
+    telemetry.set_enabled(was_enabled)
+    (telemetry.start_tracing if was_tracing else telemetry.stop_tracing)()
+    telemetry.reset()
+
+
+# ---- series keys ------------------------------------------------------------
+
+
+def test_series_key_no_labels():
+    assert series_key("brb.delivered", {}) == "brb.delivered"
+
+
+def test_series_key_sorts_labels():
+    k = series_key("m", {"z": 1, "a": "x"})
+    assert k == "m{a=x,z=1}"
+    assert series_key("m", {"a": "x", "z": 1}) == k
+
+
+# ---- metric primitives ------------------------------------------------------
+
+
+def test_counter_math():
+    c = Counter()
+    c.inc()
+    c.inc(5)
+    assert c.to_value() == 6
+
+
+def test_gauge_last_write_wins():
+    g = Gauge()
+    g.set(3)
+    g.set(1.5)
+    assert g.to_value() == 1.5
+
+
+def test_histogram_math():
+    h = Histogram()
+    for v in (0.001, 0.002, 0.004, 0.008, 1.0):
+        h.observe(v)
+    d = h.to_value()
+    assert d["count"] == 5
+    assert d["sum"] == pytest.approx(1.015)
+    assert d["min"] == 0.001
+    assert d["max"] == 1.0
+    assert d["mean"] == pytest.approx(1.015 / 5)
+    # quantiles are bucket-interpolated: bounded by exact min/max and ordered
+    assert d["min"] <= d["p50"] <= d["p90"] <= d["p99"] <= d["max"]
+
+
+def test_histogram_quantile_endpoints_exact():
+    h = Histogram()
+    h.observe(0.25)
+    h.observe(4.0)
+    assert h.quantile(0.0) == 0.25
+    assert h.quantile(1.0) == 4.0
+
+
+def test_histogram_zero_count():
+    h = Histogram()
+    assert h.quantile(0.5) == 0.0
+    assert h.to_value() == {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0, "mean": 0.0}
+
+
+def test_histogram_overflow_bucket():
+    h = Histogram()
+    big = DEFAULT_BUCKETS[-1] * 10
+    h.observe(big)
+    assert h.buckets[-1] == 1
+    assert h.to_value()["max"] == big
+
+
+# ---- registry ---------------------------------------------------------------
+
+
+def test_registry_label_series_are_distinct():
+    r = MetricsRegistry()
+    r.counter("msgs", kind="send").inc()
+    r.counter("msgs", kind="echo").inc(2)
+    # same (name, labels) -> same underlying series
+    r.counter("msgs", kind="send").inc()
+    snap = r.snapshot()
+    assert snap["counters"]["msgs{kind=send}"] == 2
+    assert snap["counters"]["msgs{kind=echo}"] == 2
+
+
+def test_registry_snapshot_prefix_filter():
+    r = MetricsRegistry()
+    r.counter("brb.delivered").inc()
+    r.counter("transport.bytes").inc(7)
+    r.gauge("driver.live_peers").set(4)
+    snap = r.snapshot("brb.")
+    assert list(snap["counters"]) == ["brb.delivered"]
+    assert snap["gauges"] == {}
+
+
+def test_registry_disabled_is_noop():
+    r = MetricsRegistry(enabled=False)
+    c = r.counter("x")
+    c.inc(100)
+    r.gauge("g").set(5)
+    r.histogram("h").observe(1.0)
+    snap = r.snapshot()
+    assert snap == {"counters": {}, "gauges": {}, "histograms": {}}
+    # and the no-op accessor is a shared singleton, not a fresh object per call
+    assert r.counter("x") is r.counter("y") is r.gauge("g")
+
+
+def test_module_level_disable_roundtrip():
+    telemetry.set_enabled(False)
+    telemetry.counter("dropped.while.off").inc()
+    assert telemetry.snapshot()["counters"] == {}
+    telemetry.set_enabled(True)
+    telemetry.counter("kept").inc()
+    assert telemetry.snapshot()["counters"] == {"kept": 1}
+
+
+# ---- span tracer ------------------------------------------------------------
+
+
+def test_tracer_disabled_returns_shared_null_context():
+    t = SpanTracer(enabled=False)
+    assert t.span("a") is t.span("b")
+    with t.span("a"):
+        pass
+    t.instant("marker")
+    assert t.events() == []
+
+
+def test_tracer_emits_valid_chrome_trace(tmp_path):
+    t = SpanTracer(enabled=True)
+    with t.span("round", round=0, trainers=3):
+        time.sleep(0.001)
+    t.instant("checkpoint", step=1)
+    path = tmp_path / "trace.json"
+    t.write(str(path))
+    doc = json.loads(path.read_text())
+    assert "traceEvents" in doc and doc["displayTimeUnit"] == "ms"
+    by_ph = {}
+    for ev in doc["traceEvents"]:
+        by_ph.setdefault(ev["ph"], []).append(ev)
+    assert by_ph["M"][0]["name"] == "process_name"
+    (x,) = by_ph["X"]
+    assert x["name"] == "round"
+    assert x["args"] == {"round": 0, "trainers": 3}
+    assert x["dur"] >= 1000.0  # microseconds; the sleep was >= 1ms
+    assert {"ts", "pid", "tid"} <= set(x)
+    (i,) = by_ph["i"]
+    assert i["name"] == "checkpoint"
+
+
+def test_traced_wrapper_spans_each_call():
+    telemetry.start_tracing()
+    calls = []
+    fn = telemetry.traced("dispatch.step", lambda x: calls.append(x) or x * 2)
+    assert fn(3) == 6
+    telemetry.stop_tracing()
+    assert fn(4) == 8  # off path still calls through
+    assert calls == [3, 4]
+    names = [e["name"] for e in telemetry.tracer().events() if e["ph"] == "X"]
+    assert names == ["dispatch.step"]
+
+
+# ---- phase profiler ---------------------------------------------------------
+
+
+def test_phase_stats_math():
+    s = PhaseStats()
+    s.add(1.0)
+    s.add(3.0)
+    d = s.to_dict()
+    assert d["count"] == 2
+    assert d["total_s"] == 4.0
+    assert d["mean_s"] == 2.0
+    assert d["min_s"] == 1.0
+    assert d["max_s"] == 3.0
+    assert d["per_sec"] == pytest.approx(0.5)
+
+
+def test_phase_stats_zero_count():
+    d = PhaseStats().to_dict()
+    assert d == {
+        "count": 0,
+        "total_s": 0.0,
+        "mean_s": 0.0,
+        "min_s": 0.0,
+        "max_s": 0.0,
+        "per_sec": 0.0,
+    }
+
+
+def test_profiler_no_trace_dir_fast_path():
+    p = Profiler(trace_dir=None)
+    with p.phase("round"):
+        pass
+    with p.phase("round"):
+        pass
+    with p.phase("eval"):
+        pass
+    summary = p.summary()
+    assert list(summary) == ["eval", "round"]  # sorted
+    assert summary["round"]["count"] == 2
+    assert summary["eval"]["count"] == 1
+
+
+def test_profiler_phase_emits_telemetry_span():
+    telemetry.start_tracing()
+    p = Profiler(trace_dir=None)
+    with p.phase("brb", round=7):
+        pass
+    telemetry.stop_tracing()
+    spans = [e for e in telemetry.tracer().events() if e["ph"] == "X"]
+    assert [s["name"] for s in spans] == ["brb"]
+    assert spans[0]["args"] == {"round": 7}
+
+
+def test_profiler_trace_noop_without_dir():
+    p = Profiler(trace_dir=None)
+    with p.trace():
+        pass  # must not import or start jax.profiler
+
+
+# ---- metrics persistence (satellite: crash-safe load_results) ---------------
+
+
+def test_metrics_logger_flush_contract(tmp_path):
+    path = tmp_path / "m.jsonl"
+    logger = MetricsLogger(str(path))
+    logger.log({"round": 0})
+    # record is fully on disk after log() returns, before close()
+    assert load_results(str(path)) == [{"round": 0}]
+    logger.log({"round": 1})
+    logger.close()
+    assert load_results(str(path)) == [{"round": 0}, {"round": 1}]
+
+
+def test_load_results_tolerates_truncated_final_line(tmp_path):
+    path = tmp_path / "m.jsonl"
+    path.write_text('{"round": 0}\n{"round": 1}\n{"round": 2, "eval_')
+    assert load_results(str(path)) == [{"round": 0}, {"round": 1}]
+
+
+def test_load_results_rejects_mid_file_corruption(tmp_path):
+    path = tmp_path / "m.jsonl"
+    path.write_text('{"round": 0}\nnot-json-at-all\n{"round": 2}\n')
+    with pytest.raises(json.JSONDecodeError):
+        load_results(str(path))

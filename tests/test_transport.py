@@ -8,6 +8,7 @@ from p2pdl_tpu.protocol.transport import (
     recv_frame,
     send_frame,
 )
+from p2pdl_tpu.utils import telemetry
 
 
 def test_hub_fifo_and_stats():
@@ -33,6 +34,44 @@ def test_hub_drop_and_corrupt():
     hub.send(0, 1, b"keep")
     hub.pump()
     assert got == [b"KEEP"]
+
+
+def test_hub_accounting_separates_sent_dropped_delivered():
+    """``messages_sent`` counts attempts; ``bytes_sent`` counts only what was
+    actually enqueued (post-corruption size); drops and corruptions are
+    tracked on their own so the ledger balances."""
+    hub = InMemoryHub(
+        drop=lambda s, d, b: b == b"drop-me",
+        corrupt=lambda s, d, b: b + b"!!" if b == b"grow" else b,
+    )
+    hub.register(1, lambda src, data: None)
+    hub.send(0, 1, b"drop-me")  # 7 bytes, dropped before enqueue
+    hub.send(0, 1, b"grow")  # 4 bytes in, 6 bytes enqueued
+    hub.send(0, 1, b"ok")  # clean 2 bytes
+    assert hub.messages_sent == 3
+    assert hub.messages_dropped == 1
+    assert hub.bytes_dropped == 7
+    assert hub.messages_corrupted == 1
+    assert hub.bytes_sent == 8  # 6 (corrupted) + 2, excludes the drop
+    assert hub.pump() == 2
+    assert hub.messages_delivered == 2
+    assert hub.bytes_delivered == 8
+
+
+def test_hub_accounting_feeds_telemetry_registry():
+    telemetry.reset()  # hub resolves its counter series at construction
+    hub = InMemoryHub(drop=lambda s, d, b: b == b"x")
+    hub.register(1, lambda src, data: None)
+    hub.send(0, 1, b"x")
+    hub.send(0, 1, b"yy")
+    hub.pump()
+    counters = telemetry.snapshot("transport.")["counters"]
+    assert counters["transport.messages{event=sent,transport=hub}"] == 2
+    assert counters["transport.messages{event=dropped,transport=hub}"] == 1
+    assert counters["transport.messages{event=delivered,transport=hub}"] == 1
+    assert counters["transport.bytes{event=sent,transport=hub}"] == 2
+    assert counters["transport.bytes{event=delivered,transport=hub}"] == 2
+    telemetry.reset()
 
 
 def test_framing_roundtrip():
