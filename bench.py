@@ -454,6 +454,75 @@ def telemetry_block() -> dict:
     }
 
 
+def faults_block(plan_name: str = "crash_drop_partition") -> dict:
+    """The bench JSON's ``faults`` block: chaos-plane survival counts from
+    a host-only probe (no device work, mirroring :func:`telemetry_block`).
+
+    Runs 4 BRB rounds (8 peers, f=1) under a named fault scenario — crash,
+    drops, partition/heal routed through the in-memory hub's fault hooks —
+    with the failure detector shrinking the live quorum set, then
+    exercises one Shamir seed recovery for the crashed peer. Every number
+    is deterministic (seeded plan, hash-keyed draws), so trajectory diffs
+    across PRs are signal, not noise.
+    """
+    import hashlib
+
+    import numpy as np
+
+    from p2pdl_tpu.protocol.faults import FailureDetector, FaultInjector, scenario
+    from p2pdl_tpu.protocol.secure_keys import SecureAggKeyring
+    from p2pdl_tpu.runtime.driver import _TrustPlane
+
+    peers, rounds = 8, 4
+    cfg = Config(num_peers=peers, trainers_per_round=3, byzantine_f=1)
+    plan = scenario(plan_name, peers, rounds, f=1, seed=cfg.seed)
+    plane = _TrustPlane(cfg)
+    inj = FaultInjector(plan, peers)
+    det = FailureDetector(peers, cfg.suspicion_threshold)
+    inj.install(plane.hub)
+    t0 = time.perf_counter()
+    suspected_total: set[int] = set()
+    excluded = 0
+    rounds_delivered = []
+    for r in range(rounds):
+        inj.begin_round(r)
+        inj.apply_round(plane.hub)
+        responded = {p for p in range(peers) if inj.heartbeat_ok(r, p)}
+        det.observe(r, responded)
+        suspected_total |= det.suspected
+        trainers = [t for t in (0, 3, 5) if t not in det.suspected and t not in inj.crashed]
+        digests = {
+            t: hashlib.sha256(b"fault-probe-%d-%d" % (r, t)).digest()
+            for t in trainers
+        }
+        delivered, _failed, verified = plane.run_round(
+            r, trainers, digests, dark=frozenset(det.suspected)
+        )
+        rounds_delivered.append(delivered)
+        excluded += len(set(trainers) - set(verified))
+    # Shamir dropout recovery for the scenario's crashed peer: survivors'
+    # shares reconstruct its scalar; the re-derived seed row must match the
+    # true pairwise matrix bit-exact.
+    recovered = 0
+    if inj.crashed:
+        dropped = sorted(inj.crashed)[0]
+        kr = SecureAggKeyring(peers, seed=cfg.seed)
+        kr.distribute_shares()
+        holders = [p for p in range(peers) if p not in inj.crashed]
+        row = kr.reconstruct_seeds_for_dropped(dropped, holders)
+        recovered = int(np.array_equal(row, kr.seed_matrix()[dropped]))
+    return {
+        "plan": plan.name,
+        "rounds": rounds,
+        "wall_s": round(time.perf_counter() - t0, 4),
+        "injected": dict(inj.injected),
+        "suspected": sorted(suspected_total),
+        "excluded_trainer_rounds": excluded,
+        "peers_delivered_per_round": rounds_delivered,
+        "mask_recoveries": recovered,
+    }
+
+
 def run_staged_headline() -> dict:
     """8 -> 128 -> 1024 peers, each written to BENCH_STAGES.json as it
     lands; returns the headline record (largest successful stage).
@@ -1207,6 +1276,16 @@ def main() -> None:
         rec["telemetry"] = telemetry_block()
     except Exception as e:  # noqa: BLE001 - headline must still print
         rec["telemetry"] = {"error": str(e)[:300]}
+    # Chaos-plane survival counts (ISSUE 3), same degrade contract.
+    plan_name = "crash_drop_partition"
+    if "--fault-plan" in sys.argv:
+        i = sys.argv.index("--fault-plan")
+        if len(sys.argv) > i + 1:
+            plan_name = sys.argv[i + 1]
+    try:
+        rec["faults"] = faults_block(plan_name)
+    except Exception as e:  # noqa: BLE001 - headline must still print
+        rec["faults"] = {"error": str(e)[:300]}
     print(json.dumps(rec))
 
 

@@ -5,6 +5,7 @@ entry is ``python main.py`` + curl. Here every config knob is a flag:
 
     python -m p2pdl_tpu.cli --num-peers 8 --aggregator krum --rounds 5
     python -m p2pdl_tpu.cli serve --port 5000      # HTTP orchestrator
+    python -m p2pdl_tpu.cli chaos --brb --fault-plan crash_drop_partition
 """
 
 from __future__ import annotations
@@ -22,7 +23,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "mode", nargs="?", default="run",
-        choices=["run", "serve", "bench", "report"],
+        choices=["run", "serve", "bench", "report", "chaos"],
     )
     p.add_argument("--num-peers", type=int, default=8)
     p.add_argument("--trainers-per-round", type=int, default=3)
@@ -311,6 +312,22 @@ def build_parser() -> argparse.ArgumentParser:
         default=0,
         help="rounds a BRB-failed peer is excluded from trainer sampling (0=off)",
     )
+    p.add_argument(
+        "--fault-plan",
+        default=None,
+        metavar="PLAN",
+        help="chaos plane: a named scenario (baseline, lossy, "
+        "partition_heal, crash_drop_partition, crash_churn), inline "
+        "FaultPlan JSON, or a path to a FaultPlan JSON file; chaos mode "
+        "defaults to crash_drop_partition",
+    )
+    p.add_argument(
+        "--suspicion-threshold",
+        type=int,
+        default=2,
+        help="consecutive missed heartbeats before the failure detector "
+        "suspects a peer (excluded from sampling and BRB quorums)",
+    )
     p.add_argument("--port", type=int, default=5000, help="HTTP port (serve mode)")
     p.add_argument("--n-devices", type=int, default=None, help="mesh size (default: all)")
     p.add_argument(
@@ -373,6 +390,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         brb_enabled=args.brb,
         brb_committee=args.brb_committee,
         round_timeout_s=args.round_timeout_s,
+        suspicion_threshold=args.suspicion_threshold,
         seed=args.seed,
         compute_dtype=args.compute_dtype,
         param_dtype=args.param_dtype,
@@ -601,11 +619,23 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.trace_events:
         telemetry.start_tracing()
+    # Chaos: `chaos` mode is `run` with a fault plan active (defaulting to
+    # the acceptance scenario) plus a survival-summary line at the end;
+    # --fault-plan on plain run mode injects faults without the summary
+    # framing. Either way the fused fast path is off — fault state advances
+    # per round on the host.
+    fault_plan = args.fault_plan
+    if args.mode == "chaos" and fault_plan is None:
+        fault_plan = "crash_drop_partition"
+    if fault_plan is not None and args.fused_rounds > 0:
+        _warn("a fault plan requires per-round driving; ignoring --fused-rounds")
+        args.fused_rounds = 0
     exp = Experiment(
         cfg, attack=args.attack, byz_ids=byz_ids,
         log_path=args.log_path, n_devices=args.n_devices,
         checkpoint_dir=args.checkpoint_dir, checkpoint_every=args.checkpoint_every,
         profile_dir=args.profile_dir, failure_cooldown_rounds=args.failure_cooldown,
+        fault_plan=fault_plan,
     )
     with exp.profiler.trace():
         if args.fused_rounds > 0:
@@ -623,6 +653,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.telemetry_path:
         with open(args.telemetry_path, "w") as f:
             json.dump(telemetry.snapshot(), f)
+    if exp.faults is not None:
+        print(json.dumps({
+            "survival": exp.survival_summary(),
+            "fault_plan": exp.faults.plan.to_dict(),
+        }))
     print(json.dumps({
         "profile": exp.profiler.summary(),
         "telemetry": telemetry.snapshot(),

@@ -231,6 +231,14 @@ class Config:
     # at 1024+ peers). Tolerance becomes f Byzantine COMMITTEE members
     # (m > 3f still required). Sampled once per experiment from `seed`.
     brb_committee: int = 0
+    # Failure detector: consecutive missed heartbeats before a peer is
+    # suspected (the failure-suspicion table). At the default 2, a peer
+    # crashing at round r is still sampled that round — its masked delta
+    # exercises the Shamir dropout-recovery path — and is excluded from
+    # round r+1 onward; one successful heartbeat clears the suspicion
+    # (crash-recover peers re-join). Observational runtime state, never
+    # checkpointed.
+    suspicion_threshold: int = 2
 
     # Execution.
     seed: int = 42
@@ -318,6 +326,10 @@ class Config:
                     f"within the committee); got {self.brb_committee} with "
                     f"f={self.byzantine_f}"
                 )
+        if self.suspicion_threshold < 1:
+            raise ValueError(
+                f"suspicion_threshold must be >= 1, got {self.suspicion_threshold}"
+            )
         if self.aggregator not in AGGREGATORS:
             raise ValueError(f"unknown aggregator {self.aggregator!r}; one of {AGGREGATORS}")
         if self.model not in MODELS:

@@ -35,6 +35,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -221,3 +222,23 @@ def residual_mask_sum(
 
     resid = [resid_for_leaf(i, l) for i, l in enumerate(leaves)]
     return jax.tree.unflatten(treedef, resid)
+
+
+def patch_seed_rows(seed_mat, rows: dict) -> Any:
+    """Host-side: patch Shamir-recovered seed rows into a ``[P, P, 2]``
+    pairwise-seed matrix.
+
+    ``rows`` maps a dropped peer id to its reconstructed ``[P, 2]`` seed row
+    (``SecureAggKeyring.reconstruct_seeds_for_dropped``). Pairwise seeds are
+    symmetric (``seed[i, j] == seed[j, i]``), so each recovered row is
+    written into both the row and the mirrored column; the diagonal stays
+    zero. Returns a copy — the caller's live matrix is never mutated by a
+    recovery probe.
+    """
+    patched = np.array(seed_mat, copy=True)
+    for peer, row in rows.items():
+        row = np.asarray(row, dtype=patched.dtype)
+        patched[peer, :, :] = row
+        patched[:, peer, :] = row
+        patched[peer, peer, :] = 0
+    return patched

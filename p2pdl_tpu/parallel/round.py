@@ -1022,6 +1022,21 @@ def build_trust_round_fns(
         new_params, server_m, server_v = _apply_server_update(
             cfg, state.params, new_params, state.server_m, state.server_v
         )
+        # A fully-vacated round (every trainer crashed or gated out — the
+        # chaos plane's worst case) must be a TRUE no-op: the masked sum is
+        # zero, but a stateful server optimizer would still decay momentum /
+        # advance Adam moments on that zero delta. Carry params and server
+        # state over unchanged; round_idx still advances.
+        vacant = jnp.all(trainer_idx < 0)
+
+        def keep(old, new):
+            return jax.tree.map(lambda o, n: jnp.where(vacant, o, n), old, new)
+
+        new_params = keep(state.params, new_params)
+        if server_m is not None:
+            server_m = keep(state.server_m, server_m)
+        if server_v is not None:
+            server_v = keep(state.server_v, server_v)
         return PeerState(
             params=new_params,
             opt_state=kept_opt,

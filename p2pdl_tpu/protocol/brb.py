@@ -219,6 +219,15 @@ class Broadcaster:
         self.private_key = private_key
         self.instances: dict[tuple[int, int], BRBInstance] = {}
 
+    def reconfigure(self, cfg: BRBConfig) -> None:
+        """Swap the quorum config for *future* instances (live membership:
+        when the failure detector shrinks the view, quorums recompute over
+        the live set instead of timing out against dead voters). Instances
+        already in flight keep the config they started with — changing a
+        quorum mid-instance would let the same READY set count under two
+        different thresholds."""
+        self.cfg = cfg
+
     def _instance(self, sender: int, seq: int) -> BRBInstance:
         key = (sender, seq)
         if key not in self.instances:

@@ -1,0 +1,505 @@
+"""Deterministic chaos plane: declarative fault plans, a seeded injector,
+and the heartbeat/suspicion failure detector.
+
+The reference has no failure handling at all — one silent peer stalls its
+round forever (reference ``node/node.py:73``; the ``utils/waiting.py``
+timeout is inoperative, SURVEY §2 #13). This module is the other half of
+surviving that: PR 1's telemetry *counts* failures, the chaos plane
+*injects* them on purpose and the failure detector lets rounds degrade
+gracefully instead of timing out.
+
+Design constraints:
+
+- **Declarative**: a :class:`FaultPlan` is a frozen value object (JSON
+  round-trippable) listing per-round crash-stop / crash-recover schedules,
+  message drop/corrupt/delay/duplicate/reorder rates, and network
+  partitions with heal times. Named scenarios (:func:`scenario`) build
+  plans sized to a config.
+- **Deterministic**: every probabilistic decision is a pure function of
+  ``(plan.seed, round, draw-counter, src, dst)`` via SHA-256 — no
+  wall-clock, no global RNG state — so a re-run with the same seed
+  replays the exact same fault schedule and the driver's RoundRecord
+  stream is bit-identical (the acceptance bar for every robustness claim).
+- **Transport-applied**: the injector installs hooks on the extended
+  :class:`~p2pdl_tpu.protocol.transport.InMemoryHub` (drop/corrupt/delay/
+  duplicate/reorder + partition sets); crashes additionally silence a
+  peer's heartbeats so the detector's live-membership view converges.
+
+Scope note (see ROADMAP): the chaos plane models *omission* faults
+(crashes, loss, partitions, reordering) and bit corruption. Byzantine
+*equivocation* — a peer lying consistently — stays with the trust plane's
+``_TrustPlane.lie_digests`` / ``broadcast_equivocating`` hooks; both
+compose in one experiment.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Optional
+
+from p2pdl_tpu.utils import telemetry
+
+
+@dataclasses.dataclass(frozen=True)
+class CrashSpec:
+    """Crash-stop (``recover_round=None``) or crash-recover schedule for one
+    peer: dark from ``at_round`` (inclusive) until ``recover_round``
+    (exclusive). A dark peer's messages are dropped in both directions and
+    its heartbeats go unanswered."""
+
+    peer: int
+    at_round: int
+    recover_round: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if self.peer < 0:
+            raise ValueError(f"crash peer must be >= 0, got {self.peer}")
+        if self.at_round < 0:
+            raise ValueError(f"at_round must be >= 0, got {self.at_round}")
+        if self.recover_round is not None and self.recover_round <= self.at_round:
+            raise ValueError(
+                f"recover_round ({self.recover_round}) must be after "
+                f"at_round ({self.at_round})"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionSpec:
+    """Network partition active on rounds ``[at_round, heal_round)``: a
+    message is cut iff src and dst sit in *different* listed groups (peers
+    absent from every group are unrestricted — partial partitions are a
+    thing)."""
+
+    groups: tuple[tuple[int, ...], ...]
+    at_round: int
+    heal_round: int
+
+    def __post_init__(self) -> None:
+        if len(self.groups) < 2:
+            raise ValueError("a partition needs at least 2 groups")
+        seen: set[int] = set()
+        for g in self.groups:
+            for p in g:
+                if p in seen:
+                    raise ValueError(f"peer {p} appears in two partition groups")
+                seen.add(p)
+        if self.heal_round <= self.at_round:
+            raise ValueError(
+                f"heal_round ({self.heal_round}) must be after "
+                f"at_round ({self.at_round})"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Seeded, declarative fault schedule for one experiment."""
+
+    name: str = "custom"
+    seed: int = 0
+    crashes: tuple[CrashSpec, ...] = ()
+    partitions: tuple[PartitionSpec, ...] = ()
+    drop_rate: float = 0.0
+    corrupt_rate: float = 0.0
+    delay_rate: float = 0.0
+    max_delay_ticks: int = 3  # delay draws land uniformly in [1, this]
+    duplicate_rate: float = 0.0
+    reorder_rate: float = 0.0
+    # Per-leg heartbeat loss (ping + pong are two independent draws);
+    # None = reuse drop_rate, so the detector sees the same network the
+    # protocol does.
+    heartbeat_loss_rate: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        for field in (
+            "drop_rate", "corrupt_rate", "delay_rate",
+            "duplicate_rate", "reorder_rate",
+        ):
+            v = getattr(self, field)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{field} must be in [0, 1], got {v}")
+        if self.heartbeat_loss_rate is not None and not (
+            0.0 <= self.heartbeat_loss_rate <= 1.0
+        ):
+            raise ValueError(
+                f"heartbeat_loss_rate must be in [0, 1], got "
+                f"{self.heartbeat_loss_rate}"
+            )
+        if self.max_delay_ticks < 1:
+            raise ValueError(
+                f"max_delay_ticks must be >= 1, got {self.max_delay_ticks}"
+            )
+        # Normalize list inputs (JSON round-trip) to tuples so the plan
+        # stays hashable/frozen.
+        if not isinstance(self.crashes, tuple):
+            object.__setattr__(self, "crashes", tuple(self.crashes))
+        if not isinstance(self.partitions, tuple):
+            object.__setattr__(self, "partitions", tuple(self.partitions))
+
+    @property
+    def hb_loss(self) -> float:
+        return (
+            self.drop_rate
+            if self.heartbeat_loss_rate is None
+            else self.heartbeat_loss_rate
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        d = dict(d)
+        d["crashes"] = tuple(
+            c if isinstance(c, CrashSpec) else CrashSpec(**c)
+            for c in d.get("crashes", ())
+        )
+        d["partitions"] = tuple(
+            p
+            if isinstance(p, PartitionSpec)
+            else PartitionSpec(
+                groups=tuple(tuple(g) for g in p["groups"]),
+                at_round=p["at_round"],
+                heal_round=p["heal_round"],
+            )
+            for p in d.get("partitions", ())
+        )
+        return cls(**d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "FaultPlan":
+        return cls.from_dict(json.loads(s))
+
+
+SCENARIOS = (
+    "baseline",
+    "lossy",
+    "partition_heal",
+    "crash_drop_partition",
+    "crash_churn",
+)
+
+
+def scenario(
+    name: str, num_peers: int, rounds: int, f: int = 1, seed: int = 0
+) -> FaultPlan:
+    """Build a named fault plan sized to ``(num_peers, rounds, f)``.
+
+    - ``baseline``: no faults (the control arm).
+    - ``lossy``: a bad network — drops, corruption, delays, duplicates,
+      reordering — but no process faults.
+    - ``partition_heal``: one mid-experiment split that heals a round later.
+    - ``crash_drop_partition``: the acceptance scenario — crash-stop ``f``
+      peers mid-experiment + 10% message drop + one partition/heal.
+    - ``crash_churn``: crash-recover churn (a peer leaves and returns) on a
+      lightly lossy network.
+    """
+    if num_peers < 2:
+        raise ValueError(f"scenarios need >= 2 peers, got {num_peers}")
+    crash_round = max(1, rounds // 4)
+    part_round = max(crash_round + 1, rounds // 2)
+    heal_round = part_round + 1
+    # Crash the top peer ids: deterministic, and at small scale they stay
+    # clear of the low ids tests like to pin as trainers.
+    crash_ids = tuple(num_peers - 1 - i for i in range(f))
+    # Partition: split off the two highest non-crashed-adjacent peers so a
+    # quorum-capable majority side always exists (n - f - 2 > 3f holds for
+    # every config the trust plane accepts at these sizes).
+    minority = tuple(sorted(crash_ids) + [min(crash_ids) - 1])
+    majority = tuple(p for p in range(num_peers) if p not in minority)
+    if name == "baseline":
+        return FaultPlan(name=name, seed=seed)
+    if name == "lossy":
+        return FaultPlan(
+            name=name, seed=seed, drop_rate=0.05, corrupt_rate=0.01,
+            delay_rate=0.2, max_delay_ticks=3, duplicate_rate=0.05,
+            reorder_rate=0.1,
+        )
+    if name == "partition_heal":
+        return FaultPlan(
+            name=name, seed=seed,
+            partitions=(
+                PartitionSpec(
+                    groups=(majority, minority),
+                    at_round=part_round, heal_round=heal_round,
+                ),
+            ),
+        )
+    if name == "crash_drop_partition":
+        return FaultPlan(
+            name=name, seed=seed, drop_rate=0.10,
+            crashes=tuple(CrashSpec(peer=p, at_round=crash_round) for p in crash_ids),
+            partitions=(
+                PartitionSpec(
+                    groups=(majority, minority),
+                    at_round=part_round, heal_round=heal_round,
+                ),
+            ),
+        )
+    if name == "crash_churn":
+        churn = tuple(
+            CrashSpec(
+                peer=p, at_round=crash_round,
+                recover_round=min(rounds, crash_round + 2),
+            )
+            for p in crash_ids
+        )
+        return FaultPlan(name=name, seed=seed, drop_rate=0.02, crashes=churn)
+    raise ValueError(f"unknown scenario {name!r}; known: {', '.join(SCENARIOS)}")
+
+
+def resolve_plan(
+    spec, num_peers: int, rounds: int, f: int = 1, seed: int = 0
+) -> FaultPlan:
+    """Resolve a plan spec: a FaultPlan passes through; a dict builds one; a
+    string is a scenario name, inline JSON (``{...}``), or a JSON file path."""
+    if isinstance(spec, FaultPlan):
+        return spec
+    if isinstance(spec, dict):
+        return FaultPlan.from_dict(spec)
+    if isinstance(spec, str):
+        if spec in SCENARIOS:
+            return scenario(spec, num_peers, rounds, f=f, seed=seed)
+        if spec.lstrip().startswith("{"):
+            return FaultPlan.from_json(spec)
+        if os.path.exists(spec):
+            with open(spec) as fh:
+                return FaultPlan.from_json(fh.read())
+        raise ValueError(
+            f"fault plan {spec!r} is neither a known scenario "
+            f"({', '.join(SCENARIOS)}), inline JSON, nor an existing file"
+        )
+    raise TypeError(f"cannot resolve a fault plan from {type(spec).__name__}")
+
+
+class FailureDetector:
+    """Heartbeat/suspicion table -> live membership view.
+
+    Each round every peer is probed (ping + pong through the fault model);
+    ``suspicion_threshold`` *consecutive* misses mark a peer suspected —
+    excluded from trainer sampling and from the BRB live-quorum set — and
+    one successful heartbeat clears it (crash-recover peers re-join). This
+    is the "failure-suspicion table" the config's selection notes
+    anticipated: observational runtime state, deliberately not
+    checkpointed (a resumed experiment starts with a clean slate, like any
+    real failure detector).
+
+    Partition note: the view is the *aggregate* over all observers — in a
+    partitioned network every side still hosts live peers, so partitions
+    degrade delivery (and show up as BRB failures) without evicting
+    members; only crashes and sustained loss do.
+    """
+
+    def __init__(self, num_peers: int, suspicion_threshold: int = 2) -> None:
+        if suspicion_threshold < 1:
+            raise ValueError(
+                f"suspicion_threshold must be >= 1, got {suspicion_threshold}"
+            )
+        self.num_peers = num_peers
+        self.suspicion_threshold = suspicion_threshold
+        self.misses = [0] * num_peers
+        self.suspected: set[int] = set()
+
+    def observe(
+        self, round_idx: int, responded: set[int]
+    ) -> tuple[list[int], list[int]]:
+        """Fold one round of heartbeat outcomes into the table; returns
+        ``(newly_suspected, recovered)`` (both sorted)."""
+        newly: list[int] = []
+        recovered: list[int] = []
+        for p in range(self.num_peers):
+            if p in responded:
+                self.misses[p] = 0
+                if p in self.suspected:
+                    self.suspected.discard(p)
+                    recovered.append(p)
+            else:
+                self.misses[p] += 1
+                if (
+                    self.misses[p] >= self.suspicion_threshold
+                    and p not in self.suspected
+                ):
+                    self.suspected.add(p)
+                    newly.append(p)
+        return newly, recovered
+
+    def live(self) -> list[int]:
+        return [p for p in range(self.num_peers) if p not in self.suspected]
+
+
+class FaultInjector:
+    """Applies a :class:`FaultPlan` to an experiment, deterministically.
+
+    Per round the driver calls :meth:`begin_round` (advances crash/partition
+    state, returns the round's fault *events*) and :meth:`apply_round`
+    (pushes the active partition onto the hub). The message-fate hooks
+    installed by :meth:`install` draw from a counter-keyed SHA-256 PRF, so
+    identical traffic sees identical faults across runs.
+    """
+
+    def __init__(self, plan: FaultPlan, num_peers: int) -> None:
+        for c in plan.crashes:
+            if c.peer >= num_peers:
+                raise ValueError(
+                    f"crash peer {c.peer} out of range for {num_peers} peers"
+                )
+        for part in plan.partitions:
+            for g in part.groups:
+                for p in g:
+                    if p >= num_peers:
+                        raise ValueError(
+                            f"partition peer {p} out of range for "
+                            f"{num_peers} peers"
+                        )
+        self.plan = plan
+        self.num_peers = num_peers
+        self.crashed: set[int] = set()
+        self.partition: Optional[tuple[tuple[int, ...], ...]] = None
+        self.injected: collections.Counter = collections.Counter()  # cumulative
+        self.round_injected: collections.Counter = collections.Counter()
+        self._round = -1
+        self._draws = 0
+
+    # -- deterministic PRF ---------------------------------------------
+    def _u(self, *key) -> float:
+        """Uniform in [0, 1) as a pure function of (plan.seed, key)."""
+        h = hashlib.sha256(
+            ("fault|%d|" % self.plan.seed + "|".join(str(k) for k in key)).encode()
+        ).digest()
+        return int.from_bytes(h[:8], "big") / 2.0**64
+
+    def _count(self, kind: str) -> None:
+        self.injected[kind] += 1
+        self.round_injected[kind] += 1
+        telemetry.counter("chaos.faults", type=kind).inc()
+
+    # -- round lifecycle ------------------------------------------------
+    def begin_round(self, round_idx: int) -> list[dict]:
+        """Advance crash/partition state to ``round_idx``; returns this
+        round's fault events (crash/recover/partition/heal) and resets the
+        per-round injected-message counter."""
+        self._round = round_idx
+        self._draws = 0
+        self.round_injected = collections.Counter()
+        events: list[dict] = []
+        for c in self.plan.crashes:
+            if c.at_round == round_idx:
+                self.crashed.add(c.peer)
+                events.append({"event": "crash", "peer": c.peer})
+                self._count("crash")
+            if c.recover_round == round_idx:
+                self.crashed.discard(c.peer)
+                events.append({"event": "recover", "peer": c.peer})
+                self._count("recover")
+        active = None
+        for part in self.plan.partitions:
+            if part.at_round == round_idx:
+                events.append(
+                    {"event": "partition", "groups": [list(g) for g in part.groups]}
+                )
+                self._count("partition")
+            if part.heal_round == round_idx:
+                events.append({"event": "heal"})
+                self._count("heal")
+            if part.at_round <= round_idx < part.heal_round:
+                active = part.groups
+        self.partition = active
+        return events
+
+    def apply_round(self, hub) -> None:
+        """Push the current partition state onto the hub (None = no hub, the
+        fault plan still drives membership through heartbeats)."""
+        if hub is None:
+            return
+        if self.partition is not None:
+            hub.set_partition(self.partition)
+        else:
+            hub.clear_partition()
+
+    def install(self, hub) -> None:
+        """Install the message-fate hooks on an InMemoryHub."""
+        hub.drop = self._drop
+        if self.plan.corrupt_rate > 0.0:
+            hub.corrupt = self._corrupt
+        hub.delay = self._delay
+        hub.duplicate = self._duplicate
+        hub.reorder = self._reorder
+
+    # -- message fates (InMemoryHub hook signatures) --------------------
+    def _drop(self, src: int, dst: int, data: bytes) -> bool:
+        if src in self.crashed or dst in self.crashed:
+            self._count("crash_drop")
+            return True
+        if self.plan.drop_rate <= 0.0:
+            return False
+        self._draws += 1
+        if self._u(self._round, "drop", self._draws, src, dst) < self.plan.drop_rate:
+            self._count("drop")
+            return True
+        return False
+
+    def _corrupt(self, src: int, dst: int, data: bytes) -> bytes:
+        if self.plan.corrupt_rate <= 0.0 or not data:
+            return data
+        self._draws += 1
+        if self._u(self._round, "corrupt", self._draws, src, dst) >= self.plan.corrupt_rate:
+            return data
+        self._count("corrupt")
+        pos = int(self._u(self._round, "cpos", self._draws, src, dst) * len(data))
+        flipped = bytearray(data)
+        flipped[pos] ^= 0xFF
+        return bytes(flipped)
+
+    def _delay(self, src: int, dst: int, data: bytes) -> int:
+        if self.plan.delay_rate <= 0.0:
+            return 0
+        self._draws += 1
+        if self._u(self._round, "delay", self._draws, src, dst) >= self.plan.delay_rate:
+            return 0
+        self._count("delay")
+        ticks = 1 + int(
+            self._u(self._round, "dticks", self._draws, src, dst)
+            * self.plan.max_delay_ticks
+        )
+        return min(ticks, self.plan.max_delay_ticks)
+
+    def _duplicate(self, src: int, dst: int, data: bytes) -> bool:
+        if self.plan.duplicate_rate <= 0.0:
+            return False
+        self._draws += 1
+        if self._u(self._round, "dup", self._draws, src, dst) < self.plan.duplicate_rate:
+            self._count("duplicate")
+            return True
+        return False
+
+    def _reorder(self, src: int, dst: int, data: bytes) -> bool:
+        if self.plan.reorder_rate <= 0.0:
+            return False
+        self._draws += 1
+        if self._u(self._round, "reorder", self._draws, src, dst) < self.plan.reorder_rate:
+            self._count("reorder")
+            return True
+        return False
+
+    # -- heartbeats -----------------------------------------------------
+    def heartbeat_ok(self, round_idx: int, peer: int) -> bool:
+        """Did ``peer``'s heartbeat land this round? Crashed peers never
+        answer; otherwise the ping and the pong each survive the per-leg
+        loss rate. Keyed directly on (round, peer) — independent of hub
+        traffic — so the membership schedule is a closed function of the
+        plan."""
+        if peer in self.crashed:
+            return False
+        rate = self.plan.hb_loss
+        if rate <= 0.0:
+            return True
+        return (
+            self._u(round_idx, "hb", peer, 0) >= rate
+            and self._u(round_idx, "hb", peer, 1) >= rate
+        )

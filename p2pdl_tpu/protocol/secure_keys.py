@@ -353,6 +353,12 @@ class SecureAggKeyring:
             return self.share_threshold
         return len(self._committees[owner]) // 2 + 1
 
+    @property
+    def shares_distributed(self) -> bool:
+        """Whether :meth:`distribute_shares` has run — i.e. dropout
+        recovery (:meth:`reconstruct_seeds_for_dropped`) is available."""
+        return self._shares is not None
+
     def distribute_shares(self, rng=None, committees: list[list[int]] | None = None) -> None:
         """Shamir-share every peer's private scalar — among the full peer
         set by default (share ``x = h + 1`` held by peer ``h``), or among

@@ -23,10 +23,12 @@ from __future__ import annotations
 
 import base64
 import collections
+import hashlib
 import json
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Optional
 
 from p2pdl_tpu.protocol.brb import BRBMessage
@@ -44,12 +46,23 @@ def send_frame(sock: socket.socket, data: bytes) -> None:
 
 
 def recv_frame(sock: socket.socket) -> Optional[bytes]:
-    """Read one length-prefixed frame; None on EOF/oversize."""
+    """Read one length-prefixed frame; None on EOF/oversize.
+
+    An oversize length prefix means the stream is unframeable garbage (or
+    hostile): the bytes that follow can't be skipped reliably, so the
+    socket is *closed* rather than left desynchronized mid-stream where
+    the next read would parse payload bytes as a header. Counted under the
+    existing rejected series.
+    """
     header = _recv_exact(sock, _LEN.size)
     if header is None:
         return None
     (length,) = _LEN.unpack(header)
     if length > MAX_FRAME:
+        telemetry.counter(
+            "transport.messages", transport="tcp", event="rejected"
+        ).inc()
+        sock.close()
         return None
     return _recv_exact(sock, length)
 
@@ -106,50 +119,103 @@ def brb_from_wire(data: bytes) -> Optional[BRBMessage]:
 class InMemoryHub:
     """Deterministic synchronous message router with fault injection.
 
-    ``drop(src, dst, data) -> bool`` and ``corrupt(src, dst, data) -> bytes``
-    hooks inject network faults; ``pump()`` delivers queued messages FIFO
-    until quiescence, so protocol cascades (echo storms) run to completion
-    deterministically — no threads, no races.
+    Fault hooks, all ``(src, dst, data)``-keyed and optional:
+
+    - ``drop(...) -> bool``: message vanishes.
+    - ``corrupt(...) -> bytes``: payload replaced (bit flips).
+    - ``delay(...) -> int``: ticks to hold the message in the delay queue
+      (0 = deliver normally). A "tick" is one quiescence point: delayed
+      messages are promoted only once the main queue drains, so a delay
+      reorders the message past the current protocol cascade while
+      ``pump()`` still runs to *true* quiescence — ``while hub.pump()``
+      loops cannot hang on a delayed message, and replay stays exact.
+    - ``duplicate(...) -> bool``: enqueue the message twice.
+    - ``reorder(...) -> bool``: the message jumps ahead of the most
+      recently queued one.
+
+    ``set_partition(groups)`` cuts messages between different groups
+    (peers absent from every group are unrestricted) until
+    ``clear_partition()``.
 
     Accounting contract: ``messages_sent`` counts send *attempts*;
     ``bytes_sent`` counts only bytes actually enqueued, at their
-    post-corruption length (what the wire would carry — a dropped frame
-    costs no bytes, a corrupted one costs what arrives). Drops and
-    corruptions are tracked separately (``messages_dropped`` /
-    ``bytes_dropped`` / ``messages_corrupted``), and ``pump()`` tracks
-    the delivered side (``messages_delivered`` / ``bytes_delivered``).
-    Every counter mirrors into the telemetry registry under
-    ``transport.messages{transport=hub,...}`` / ``transport.bytes{...}``;
-    registry series are resolved at construction, so ``telemetry.reset()``
-    in tests should precede hub creation.
+    post-corruption length and once per copy (what the wire would carry —
+    a dropped or partition-cut frame costs no bytes, a corrupted one costs
+    what arrives, a duplicated one costs double). Drops, partition cuts,
+    and corruptions are tracked separately (``messages_dropped`` /
+    ``bytes_dropped`` / ``messages_partitioned`` / ``messages_corrupted``),
+    and ``pump()`` tracks the delivered side (``messages_delivered`` /
+    ``bytes_delivered``). Every counter mirrors into the telemetry
+    registry under ``transport.messages{transport=hub,...}`` /
+    ``transport.bytes{...}``; registry series are resolved at
+    construction, so ``telemetry.reset()`` in tests should precede hub
+    creation.
     """
 
     def __init__(
         self,
         drop: Optional[Callable[[int, int, bytes], bool]] = None,
         corrupt: Optional[Callable[[int, int, bytes], bytes]] = None,
+        delay: Optional[Callable[[int, int, bytes], int]] = None,
+        duplicate: Optional[Callable[[int, int, bytes], bool]] = None,
+        reorder: Optional[Callable[[int, int, bytes], bool]] = None,
     ) -> None:
         self._handlers: dict[int, Handler] = {}
         self._queue: collections.deque[tuple[int, int, bytes]] = collections.deque()
+        # (due_tick, seq, src, dst, data); seq keeps promotion FIFO-stable.
+        self._delayed: list[tuple[int, int, int, int, bytes]] = []
+        self._seq = 0
+        self._tick = 0
+        self._partition: Optional[tuple[frozenset[int], ...]] = None
         self.drop = drop
         self.corrupt = corrupt
+        self.delay = delay
+        self.duplicate = duplicate
+        self.reorder = reorder
         self.messages_sent = 0
         self.bytes_sent = 0
         self.messages_dropped = 0
         self.bytes_dropped = 0
+        self.messages_partitioned = 0
         self.messages_corrupted = 0
+        self.messages_delayed = 0
+        self.messages_duplicated = 0
+        self.messages_reordered = 0
         self.messages_delivered = 0
         self.bytes_delivered = 0
+        self.pump_capped = 0
         self._c_sent = telemetry.counter("transport.messages", transport="hub", event="sent")
         self._c_bytes = telemetry.counter("transport.bytes", transport="hub", event="sent")
         self._c_drop = telemetry.counter("transport.messages", transport="hub", event="dropped")
         self._c_bytes_drop = telemetry.counter("transport.bytes", transport="hub", event="dropped")
+        self._c_partition = telemetry.counter("transport.messages", transport="hub", event="partitioned")
         self._c_corrupt = telemetry.counter("transport.messages", transport="hub", event="corrupted")
+        self._c_delay = telemetry.counter("transport.messages", transport="hub", event="delayed")
+        self._c_dup = telemetry.counter("transport.messages", transport="hub", event="duplicated")
+        self._c_reorder = telemetry.counter("transport.messages", transport="hub", event="reordered")
         self._c_deliver = telemetry.counter("transport.messages", transport="hub", event="delivered")
         self._c_bytes_deliver = telemetry.counter("transport.bytes", transport="hub", event="delivered")
+        self._c_capped = telemetry.counter("transport.pump_capped", transport="hub")
 
     def register(self, peer_id: int, handler: Handler) -> None:
         self._handlers[peer_id] = handler
+
+    def set_partition(self, groups) -> None:
+        self._partition = tuple(frozenset(g) for g in groups)
+
+    def clear_partition(self) -> None:
+        self._partition = None
+
+    def _cut(self, src: int, dst: int) -> bool:
+        if self._partition is None:
+            return False
+        src_g = dst_g = None
+        for i, g in enumerate(self._partition):
+            if src in g:
+                src_g = i
+            if dst in g:
+                dst_g = i
+        return src_g is not None and dst_g is not None and src_g != dst_g
 
     def send(self, src: int, dst: int, data: bytes) -> None:
         self.messages_sent += 1
@@ -160,20 +226,71 @@ class InMemoryHub:
             self._c_drop.inc()
             self._c_bytes_drop.inc(len(data))
             return
+        if self._cut(src, dst):
+            self.messages_partitioned += 1
+            self._c_partition.inc()
+            return
         if self.corrupt is not None:
             corrupted = self.corrupt(src, dst, data)
             if corrupted != data:
                 self.messages_corrupted += 1
                 self._c_corrupt.inc()
             data = corrupted
-        self.bytes_sent += len(data)
-        self._c_bytes.inc(len(data))
-        self._queue.append((src, dst, data))
+        copies = 1
+        if self.duplicate is not None and self.duplicate(src, dst, data):
+            copies = 2
+            self.messages_duplicated += 1
+            self._c_dup.inc()
+        for _ in range(copies):
+            self.bytes_sent += len(data)
+            self._c_bytes.inc(len(data))
+            ticks = self.delay(src, dst, data) if self.delay is not None else 0
+            if ticks > 0:
+                self._seq += 1
+                self._delayed.append((self._tick + ticks, self._seq, src, dst, data))
+                self.messages_delayed += 1
+                self._c_delay.inc()
+            elif (
+                self.reorder is not None
+                and self._queue
+                and self.reorder(src, dst, data)
+            ):
+                self._queue.insert(len(self._queue) - 1, (src, dst, data))
+                self.messages_reordered += 1
+                self._c_reorder.inc()
+            else:
+                self._queue.append((src, dst, data))
+
+    def pending(self) -> int:
+        """Messages not yet delivered: queued + held in the delay queue."""
+        return len(self._queue) + len(self._delayed)
+
+    def _promote_due(self) -> None:
+        """Advance the clock to the earliest due delayed message and move
+        everything due onto the main queue (oldest first)."""
+        self._tick = min(d[0] for d in self._delayed)
+        due = sorted(d for d in self._delayed if d[0] <= self._tick)
+        self._delayed = [d for d in self._delayed if d[0] > self._tick]
+        for _, _, src, dst, data in due:
+            self._queue.append((src, dst, data))
 
     def pump(self, max_messages: int = 1_000_000) -> int:
-        """Deliver until quiescent; returns number delivered."""
+        """Deliver until quiescent; returns number delivered.
+
+        Quiescence includes the delay queue: when the main queue drains,
+        due delayed messages are promoted (ticking the clock forward) and
+        delivery continues. A capped exit with work still pending is *not*
+        quiescence — it bumps ``pump_capped`` and a telemetry warning
+        counter so a too-small ``max_messages`` can't silently truncate a
+        protocol cascade.
+        """
         delivered = 0
-        while self._queue and delivered < max_messages:
+        while delivered < max_messages:
+            if not self._queue:
+                if not self._delayed:
+                    break
+                self._promote_due()
+                continue
             src, dst, data = self._queue.popleft()
             handler = self._handlers.get(dst)
             if handler is not None:
@@ -183,6 +300,9 @@ class InMemoryHub:
             self.bytes_delivered += len(data)
             self._c_deliver.inc()
             self._c_bytes_deliver.inc(len(data))
+        if delivered >= max_messages and self.pending():
+            self.pump_capped += 1
+            self._c_capped.inc()
         return delivered
 
 
@@ -192,11 +312,23 @@ class TCPTransport:
     kept deliberately — control messages are small and rare; the data plane
     never touches TCP)."""
 
-    def __init__(self, my_id: int, host: str, port: int, handler: Handler) -> None:
+    def __init__(
+        self,
+        my_id: int,
+        host: str,
+        port: int,
+        handler: Handler,
+        send_retries: int = 2,
+        send_backoff_s: float = 0.05,
+        send_timeout_s: float = 5.0,
+    ) -> None:
         self.my_id = my_id
         self.host = host
         self.port = port
         self.handler = handler
+        self.send_retries = send_retries
+        self.send_backoff_s = send_backoff_s
+        self.send_timeout_s = send_timeout_s
         self.peers: dict[int, tuple[str, int]] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -207,6 +339,7 @@ class TCPTransport:
         self._c_deliver = telemetry.counter("transport.messages", transport="tcp", event="delivered")
         self._c_bytes_deliver = telemetry.counter("transport.bytes", transport="tcp", event="delivered")
         self._c_reject = telemetry.counter("transport.messages", transport="tcp", event="rejected")
+        self._c_retry = telemetry.counter("transport.messages", transport="tcp", event="retry")
 
     def add_peer(self, peer_id: int, host: str, port: int) -> None:
         self.peers[peer_id] = (host, port)
@@ -235,7 +368,8 @@ class TCPTransport:
         with conn:
             frame = recv_frame(conn)
             if frame is None or len(frame) < _LEN.size:
-                self._c_reject.inc()  # malformed/oversize/truncated frame
+                if conn.fileno() != -1:  # oversize already counted+closed in recv_frame
+                    self._c_reject.inc()  # malformed/truncated frame
                 return
             (src,) = _LEN.unpack(frame[: _LEN.size])
             self._c_deliver.inc()
@@ -243,21 +377,37 @@ class TCPTransport:
             self.handler(src, frame[_LEN.size :])
 
     def send(self, dst: int, data: bytes) -> bool:
+        """Send one frame with bounded retries.
+
+        Fresh connection per frame (the reference's discipline); each
+        attempt gets its own ``send_timeout_s``, and failed attempts back
+        off exponentially with deterministic jitter (keyed on route +
+        attempt, not a global RNG) before retrying — transient refusals
+        during peer restarts no longer fail the round outright. The final
+        failure still returns False and counts ``event=send_failed``;
+        intermediate attempts count ``event=retry``.
+        """
         addr = self.peers.get(dst)
         if addr is None:
             self._c_fail.inc()
             return False
-        try:
-            # Fresh connection per frame: a refused/reset connection is the
-            # reconnect-failure signal this counter pair captures.
-            with socket.create_connection(addr, timeout=5.0) as s:
-                send_frame(s, _LEN.pack(self.my_id) + data)
-            self._c_sent.inc()
-            self._c_bytes.inc(len(data))
-            return True
-        except OSError:
-            self._c_fail.inc()
-            return False
+        backoff = self.send_backoff_s
+        for attempt in range(self.send_retries + 1):
+            try:
+                with socket.create_connection(addr, timeout=self.send_timeout_s) as s:
+                    send_frame(s, _LEN.pack(self.my_id) + data)
+                self._c_sent.inc()
+                self._c_bytes.inc(len(data))
+                return True
+            except OSError:
+                if attempt == self.send_retries:
+                    break
+                self._c_retry.inc()
+                h = hashlib.sha256(f"{self.my_id}|{dst}|{attempt}".encode()).digest()
+                time.sleep(backoff * (1.0 + h[0] / 255.0 * 0.5))
+                backoff *= 2.0
+        self._c_fail.inc()
+        return False
 
     def stop(self) -> None:
         self._stop.set()

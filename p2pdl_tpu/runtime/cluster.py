@@ -156,6 +156,18 @@ class Cluster:
             if node.node_id not in failed and node.node_id not in self._stopped:
                 node._delivered.set()
 
+    def membership(self) -> dict[str, list[int]]:
+        """The failure detector's live-membership view plus the cluster's
+        own administratively-stopped set — the node-level surface over the
+        chaos plane's suspicion table (a Node's ``stop()`` and a fault
+        plan's crash look identical to a peer asking "who can I reach?")."""
+        det = self.experiment.detector
+        return {
+            "live": [p for p in det.live() if p not in self._stopped],
+            "suspected": sorted(det.suspected),
+            "stopped": sorted(self._stopped),
+        }
+
     def per_node_results(self, node_ids: Optional[list[int]] = None) -> list[dict[str, Any]]:
         """Per-node ``{accuracy, addr, port}`` on each node's own shard
         (the reference's per-tester entries in the HTTP learning progress,

@@ -42,6 +42,7 @@ from p2pdl_tpu.parallel import (
 )
 from p2pdl_tpu.protocol.brb import BRBConfig, Broadcaster
 from p2pdl_tpu.protocol.crypto import KeyServer, digest_update, generate_key_pair
+from p2pdl_tpu.protocol.faults import FailureDetector, FaultInjector, resolve_plan
 from p2pdl_tpu.protocol.transport import InMemoryHub, brb_from_wire, brb_to_wire
 from p2pdl_tpu.utils import telemetry
 from p2pdl_tpu.utils.metrics import MetricsLogger
@@ -68,6 +69,14 @@ class RoundRecord:
     # Cumulative (eps, delta)-DP guarantee through THIS round (None unless
     # dp_noise_multiplier > 0): utils/dp.rdp_epsilon over round+1 releases.
     dp_epsilon: Optional[float] = None
+    # Chaos plane (None unless a FaultPlan is active). All deterministic —
+    # duration_s stays the only wall-clock field, so a same-seed rerun's
+    # record stream is bit-identical once duration_s is stripped.
+    fault_events: Optional[list[dict]] = None  # crash/recover/partition/heal/suspect
+    suspected_peers: Optional[list[int]] = None  # failure detector's view this round
+    excluded_peers: Optional[list[int]] = None  # ineligible for sampling this round
+    faults_injected: Optional[dict[str, int]] = None  # per-round message-fault counts
+    mask_recoveries: Optional[list[int]] = None  # peers whose seeds Shamir-recovered
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -115,6 +124,10 @@ class _TrustPlane:
         else:
             self.committee = list(range(cfg.num_peers))
         brb_cfg = BRBConfig(len(self.committee), cfg.byzantine_f)
+        # Live membership view: run_round() shrinks this to the non-suspected
+        # committee members so quorums recompute over peers that can actually
+        # vote instead of timing out against the dead.
+        self._live_committee = list(self.committee)
         self._keys = []
         # Every peer gets a keypair + broadcaster (any peer can be sampled
         # as a trainer and must be able to originate a SEND); only
@@ -139,11 +152,13 @@ class _TrustPlane:
         return handler
 
     def _fan_out(self, src: int, msg) -> None:
-        # Fan out to every COMMITTEE member INCLUDING self (when src is
+        # Fan out to every LIVE committee member INCLUDING self (when src is
         # one): in Bracha each voting peer echoes, readies, and counts its
-        # own votes. With the full committee this is every peer.
+        # own votes. With the full committee and no suspicions this is
+        # every peer; suspected members get nothing (their links are dead
+        # anyway — skipping them keeps control-message accounting honest).
         wire = brb_to_wire(msg)
-        for dst in self.committee:
+        for dst in self._live_committee:
             self.hub.send(src, dst, wire)
 
     def _payload(self, round_idx: int, tid: int, digest: bytes) -> bytes:
@@ -152,7 +167,11 @@ class _TrustPlane:
         ).encode()
 
     def run_round(
-        self, round_idx: int, trainer_ids: list[int], digests: dict[int, bytes]
+        self,
+        round_idx: int,
+        trainer_ids: list[int],
+        digests: dict[int, bytes],
+        dark: frozenset[int] = frozenset(),
     ) -> tuple[int, list[int], list[int]]:
         """Broadcast each trainer's update digest; returns ``(#peers that
         delivered every honest trainer's broadcast, ids of peers that did
@@ -165,7 +184,25 @@ class _TrustPlane:
         share the device state, so one recomputation stands for all).
         Byzantine trainers equivocate: half the peers receive a forged
         digest — correct BRB then either delivers one payload consistently
-        (caught by (b)) or delivers nothing (caught by (a))."""
+        (caught by (b)) or delivers nothing (caught by (a)).
+
+        ``dark`` is the failure detector's suspicion set: suspected
+        committee members are dropped from the round's voting set and the
+        Bracha quorums recompute over the survivors (graceful degradation —
+        a quorum sized for n voters would wait forever on n - |dark|), as
+        long as the live set keeps ``n > 3f``; below that the full
+        committee config is kept (shrinking further would let f Byzantine
+        voters forge a quorum, so the round is allowed to fail loudly
+        instead)."""
+        live = [p for p in self.committee if p not in dark]
+        if dark and len(live) > 3 * self.cfg.byzantine_f:
+            live_cfg = BRBConfig(len(live), self.cfg.byzantine_f)
+        else:
+            live = list(self.committee)
+            live_cfg = BRBConfig(len(self.committee), self.cfg.byzantine_f)
+        self._live_committee = live
+        for bc in self.broadcasters:
+            bc.reconfigure(live_cfg)
         for tid in trainer_ids:
             committed = self.lie_digests.get(tid, digests[tid])
             payload = self._payload(round_idx, tid, committed)
@@ -176,8 +213,8 @@ class _TrustPlane:
                 send_a, send_b = self.broadcasters[tid].broadcast_equivocating(
                     round_idx, payload, forged
                 )
-                half = len(self.committee) // 2
-                for rank, dst in enumerate(self.committee):
+                half = len(live) // 2
+                for rank, dst in enumerate(live):
                     wire = brb_to_wire(send_a if rank < half else send_b)
                     self.hub.send(tid, dst, wire)
             else:
@@ -190,7 +227,7 @@ class _TrustPlane:
         delivered_at = {
             tid: [
                 pid
-                for pid in self.committee
+                for pid in live
                 if self.broadcasters[pid].delivered(tid, round_idx) is not None
             ]
             for tid in trainer_ids
@@ -204,14 +241,14 @@ class _TrustPlane:
         sender_failed = {t for t in honest_trainers if not delivered_at[t]}
         failed = [
             pid
-            for pid in self.committee
+            for pid in live
             if any(
                 pid not in delivered_at[tid]
                 for tid in honest_trainers
                 if tid not in sender_failed
             )
         ]
-        live_peers = [p for p in self.committee if p not in failed]
+        live_peers = [p for p in live if p not in failed]
         verified: list[int] = []
         for tid in trainer_ids:
             expected = self._payload(round_idx, tid, digests[tid])
@@ -224,7 +261,7 @@ class _TrustPlane:
                 verified.append(tid)
         for bc in self.broadcasters:
             bc.prune(round_idx)
-        return len(self.committee) - len(failed), failed, verified
+        return len(live) - len(failed), failed, verified
 
 
 class Experiment:
@@ -241,10 +278,23 @@ class Experiment:
         checkpoint_every: int = 1,
         profile_dir: Optional[str] = None,
         failure_cooldown_rounds: int = 0,
+        fault_plan: Optional[Any] = None,
     ) -> None:
         self.cfg = cfg
         self.attack = attack
         self.byz_ids = tuple(byz_ids)
+        # Chaos plane: a FaultPlan (object, scenario name, inline JSON, or
+        # JSON file path) drives deterministic fault injection; the failure
+        # detector always exists (empty suspicion set without faults) so
+        # the membership view is one code path, not two.
+        self.faults = None
+        if fault_plan is not None:
+            plan = resolve_plan(
+                fault_plan, cfg.num_peers, cfg.rounds,
+                f=cfg.byzantine_f, seed=cfg.seed,
+            )
+            self.faults = FaultInjector(plan, cfg.num_peers)
+        self.detector = FailureDetector(cfg.num_peers, cfg.suspicion_threshold)
         # Failure detection -> exclusion (reference has none: one silent peer
         # stalls its round forever, reference ``node/node.py:73`` +
         # ``utils/waiting.py``). Peers whose BRB delivery failed are excluded
@@ -328,6 +378,10 @@ class Experiment:
         self.eval_fn = build_eval_fn(cfg)
         self.metrics = MetricsLogger(log_path)
         self.trust = _TrustPlane(cfg, byz_ids) if cfg.brb_enabled else None
+        if self.faults is not None and self.trust is not None:
+            # Message-fate hooks route every control message through the
+            # fault model; partitions are pushed per round (apply_round).
+            self.faults.install(self.trust.hub)
         self.profiler = Profiler(profile_dir)
 
         # Last known per-peer local losses (power_of_choice selection).
@@ -379,6 +433,7 @@ class Experiment:
                 p
                 for p in range(self.cfg.num_peers)
                 if self._suspect_until.get(p, -1) < round_idx
+                and p not in self.detector.suspected
             ]
         )
         if len(eligible) < self.cfg.trainers_per_round:
@@ -421,7 +476,9 @@ class Experiment:
             for t in live
         }
         m0, b0 = self.trust.hub.messages_sent, self.trust.hub.bytes_sent
-        delivered, failed, verified = self.trust.run_round(r, live.tolist(), digests)
+        delivered, failed, verified = self.trust.run_round(
+            r, live.tolist(), digests, dark=frozenset(self.detector.suspected)
+        )
         excluded = sorted(set(live.tolist()) - set(verified))
         msgs = self.trust.hub.messages_sent - m0
         nbytes = self.trust.hub.bytes_sent - b0
@@ -449,11 +506,85 @@ class Experiment:
         )
         return round(eps, 4)
 
+    def _recover_dropped_masks(self, r: int, dropped: list[int]) -> list[int]:
+        """Shamir dropout recovery for trainers gated out after masking.
+
+        For each dropped trainer, the live holders (not dropped, not
+        suspected, not crashed) reconstruct its private scalar from their
+        shares and re-derive its pairwise-seed row; the row is verified by
+        patching it into a wiped copy of the live seed matrix
+        (``secure_agg.patch_seed_rows``) and checking it reproduces the
+        entries actually baked into the compiled round. Returns the peers
+        whose seeds recovered bit-exact; under-threshold or mismatching
+        recoveries count ``chaos.mask_recovery{outcome=...}`` and are left
+        out — the caller can see a failed recovery in the record.
+        """
+        from p2pdl_tpu.ops.secure_agg import patch_seed_rows
+
+        crashed = self.faults.crashed if self.faults is not None else frozenset()
+        holders = [
+            p
+            for p in range(self.cfg.num_peers)
+            if p not in dropped
+            and p not in self.detector.suspected
+            and p not in crashed
+        ]
+        recovered: list[int] = []
+        for tid in dropped:
+            try:
+                row = self.secure_keyring.reconstruct_seeds_for_dropped(
+                    tid, holders
+                )
+            except ValueError:
+                telemetry.counter("chaos.mask_recovery", outcome="failed").inc()
+                continue
+            wiped = self._seed_mat.copy()
+            wiped[tid, :, :] = 0
+            wiped[:, tid, :] = 0
+            patched = patch_seed_rows(wiped, {tid: row})
+            # Compare only pairs the baked matrix actually uses: the ring
+            # derivation zeroes non-neighbor pairs, the recovery row has
+            # every pair.
+            used = (self._seed_mat[tid] != 0).any(axis=-1)
+            if np.array_equal(patched[tid][used], self._seed_mat[tid][used]):
+                recovered.append(tid)
+                telemetry.counter("chaos.mask_recovery", outcome="recovered").inc()
+            else:
+                telemetry.counter("chaos.mask_recovery", outcome="mismatch").inc()
+        return recovered
+
     def run_round(self, trainers: Optional[np.ndarray] = None) -> RoundRecord:
         """Run one round. ``trainers`` overrides role sampling (the Cluster
         facade passes the set its Nodes consented to, reference
         ``main.py:59-76``); default samples per ``sample_roles``."""
         r = int(self.state.round_idx)
+        fault_events = suspected_now = excluded_now = None
+        if self.faults is not None:
+            fault_events = self.faults.begin_round(r)
+            if self.trust is not None:
+                self.faults.apply_round(self.trust.hub)
+            # Heartbeats land BEFORE sampling: membership is decided on
+            # entry to the round, so a peer crashing at round r (with the
+            # default suspicion_threshold=2) is still sampled this round —
+            # its masked-then-dropped delta is what exercises the Shamir
+            # recovery path below — and is excluded from the next round on.
+            responded = {
+                p
+                for p in range(self.cfg.num_peers)
+                if self.faults.heartbeat_ok(r, p)
+            }
+            newly, recovered = self.detector.observe(r, responded)
+            for p in newly:
+                telemetry.counter("chaos.suspected", peer=p).inc()
+                fault_events.append({"event": "suspected", "peer": p})
+            for p in recovered:
+                telemetry.counter("chaos.unsuspected", peer=p).inc()
+                fault_events.append({"event": "unsuspected", "peer": p})
+            suspected_now = sorted(self.detector.suspected)
+            excluded_now = sorted(
+                set(self.detector.suspected)
+                | {p for p, until in self._suspect_until.items() if until >= r}
+            )
         if trainers is None:
             trainers = self.sample_roles(r)
         else:
@@ -481,6 +612,7 @@ class Experiment:
         mask_key = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), r)
         t0 = time.perf_counter()
         brb_delivered = brb_failed = brb_excluded = msgs = nbytes = None
+        mask_recoveries = None
         if self._gated:
             if (
                 self.secure_keyring is not None
@@ -552,6 +684,20 @@ class Experiment:
                     mask_key, masked_idx=jnp.asarray(trainers, jnp.int32),
                     seeds=self._pair_seeds_dev,
                 )
+            if (
+                self.secure_keyring is not None
+                and self.secure_keyring.shares_distributed
+                and brb_excluded
+            ):
+                # Exercise the Bonawitz dropout-recovery flow end-to-end for
+                # every gated-out trainer: survivors' Shamir shares
+                # reconstruct the dropped scalar and re-derive its seed row
+                # — proof (recorded per round) that the aggregate the gate
+                # just admitted can still be unmasked without the dropped
+                # peer. The SPMD engine already cancels the orphaned masks
+                # from the baked matrix (residual_mask_sum), so this costs
+                # one O(P) ECDH re-derivation per dropped trainer.
+                mask_recoveries = self._recover_dropped_masks(r, brb_excluded)
             if (
                 self.secure_keyring is not None
                 and brb_excluded
@@ -641,6 +787,13 @@ class Experiment:
             control_messages=msgs,
             control_bytes=nbytes,
             dp_epsilon=self._dp_epsilon(r + 1),
+            fault_events=fault_events,
+            suspected_peers=suspected_now,
+            excluded_peers=excluded_now,
+            faults_injected=(
+                dict(self.faults.round_injected) if self.faults is not None else None
+            ),
+            mask_recoveries=mask_recoveries,
         )
         # Compile/steady split: this PROCESS's first round pays jit tracing
         # + XLA compilation (whatever round index a resumed run starts at);
@@ -703,6 +856,12 @@ class Experiment:
         complete (per-block streaming for CLI/monitoring)."""
         if self.trust is not None:
             raise ValueError("run_fused requires brb_enabled=False")
+        if self.faults is not None:
+            raise ValueError(
+                "run_fused cannot host a fault plan: crash/partition state "
+                "and heartbeats advance per round on the host, which a "
+                "fused device block bypasses — use run()"
+            )
         if self.cfg.selection == "power_of_choice":
             raise ValueError(
                 "run_fused with selection='power_of_choice' is not "
@@ -767,6 +926,35 @@ class Experiment:
                 self.checkpointer.save(self.state, self.cfg, extra=self._ckpt_extra)
         self.save_checkpoint()
         return self.records
+
+    def survival_summary(self) -> dict[str, Any]:
+        """Chaos verdict for the run so far: did every configured round
+        complete within ``round_timeout_s`` despite the fault plan, and
+        what did surviving cost? (The ``cli.py chaos`` report and the bench
+        ``faults`` block both print this.)"""
+        durations = [rec.duration_s for rec in self.records]
+        completed = len(self.records)
+        return {
+            "fault_plan": self.faults.plan.name if self.faults is not None else None,
+            "rounds_configured": self.cfg.rounds,
+            "rounds_completed": completed,
+            "survived": completed >= self.cfg.rounds
+            and (not durations or max(durations) <= self.cfg.round_timeout_s),
+            "max_round_s": round(max(durations), 4) if durations else None,
+            "round_timeout_s": self.cfg.round_timeout_s,
+            "faults_injected": dict(self.faults.injected)
+            if self.faults is not None
+            else {},
+            "crashed": sorted(self.faults.crashed) if self.faults is not None else [],
+            "suspected": sorted(self.detector.suspected),
+            "rounds_with_exclusions": sum(
+                1 for rec in self.records if rec.excluded_peers
+            ),
+            "mask_recoveries": sum(
+                len(rec.mask_recoveries or ()) for rec in self.records
+            ),
+            "final_eval_acc": self.records[-1].eval_acc if self.records else None,
+        }
 
     def run(self) -> list[RoundRecord]:
         """Run the remaining rounds (resume-aware: a restored experiment

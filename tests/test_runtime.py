@@ -368,3 +368,33 @@ def test_all_trainers_stopped_raises(small_cfg, mesh8):
         cluster.nodes[t].stop()
     with pytest.raises(RuntimeError, match="every sampled trainer is stopped"):
         cluster.run_round(trainers=[0, 2, 5])
+
+
+def test_wait_for_delivered_timeout_semantics(small_cfg, mesh8):
+    """wait_for_delivered returns False on expiry (never blocks forever,
+    unlike the reference's bare wait), True once the round delivered, and
+    honors an explicit timeout= over the config default."""
+    import time
+
+    cluster = Cluster(small_cfg)
+    node = cluster.nodes[0]
+    # No round ran: an explicit short timeout expires -> False, and it
+    # actually waited (bounded, not zero and not the config's 30s default).
+    t0 = time.monotonic()
+    assert node.wait_for_delivered(timeout=0.2) is False
+    waited = time.monotonic() - t0
+    assert 0.15 <= waited < 2.0
+    # timeout=None falls back to cfg.round_timeout_s, not forever.
+    cfg_short = small_cfg.replace(round_timeout_s=0.2)
+    node_short = Cluster(cfg_short).nodes[0]
+    t0 = time.monotonic()
+    assert node_short.wait_for_delivered() is False
+    assert time.monotonic() - t0 < 2.0
+    # After a delivered round the flag is set: True, immediately.
+    cluster.run_round(trainers=[0, 2, 5])
+    t0 = time.monotonic()
+    assert node.wait_for_delivered(timeout=5.0) is True
+    assert time.monotonic() - t0 < 1.0
+    # reset_delivered_flag rearms the barrier for the next round.
+    node.reset_delivered_flag()
+    assert node.wait_for_delivered(timeout=0.05) is False

@@ -139,3 +139,153 @@ def test_tcp_send_to_dead_peer_fails_cleanly():
         assert t.send(2, b"x") is False
     finally:
         t.stop()
+
+
+def test_hub_delay_holds_message_past_current_cascade():
+    """A delayed message is promoted only once the main queue drains, so it
+    lands after everything sent in the same cascade — but pump() still
+    reaches true quiescence in one call."""
+    hub = InMemoryHub(delay=lambda s, d, b: 2 if b == b"late" else 0)
+    got = []
+    hub.register(1, lambda src, data: got.append(data))
+    hub.send(0, 1, b"late")
+    hub.send(0, 1, b"a")
+    hub.send(0, 1, b"b")
+    assert hub.pending() == 3
+    assert hub.pump() == 3
+    assert got == [b"a", b"b", b"late"]
+    assert hub.messages_delayed == 1
+    assert hub.pending() == 0
+
+
+def test_hub_partition_cuts_across_groups_only():
+    hub = InMemoryHub()
+    got = []
+    hub.register(1, lambda src, data: got.append((src, 1)))
+    hub.register(2, lambda src, data: got.append((src, 2)))
+    hub.set_partition([(0, 1), (2, 3)])
+    hub.send(0, 2, b"cut")  # across groups
+    hub.send(2, 1, b"cut")  # across, other direction
+    hub.send(0, 1, b"ok")  # same group
+    hub.send(4, 2, b"ok")  # peer 4 is in no group: unrestricted
+    hub.pump()
+    assert got == [(0, 1), (4, 2)]
+    assert hub.messages_partitioned == 2
+    assert hub.messages_dropped == 0  # cuts are their own ledger column
+    hub.clear_partition()
+    hub.send(0, 2, b"healed")
+    hub.pump()
+    assert got[-1] == (0, 2)
+
+
+def test_hub_duplicate_and_reorder():
+    hub = InMemoryHub(
+        duplicate=lambda s, d, b: b == b"twice",
+        reorder=lambda s, d, b: b == b"jump",
+    )
+    got = []
+    hub.register(1, lambda src, data: got.append(data))
+    hub.send(0, 1, b"twice")
+    hub.pump()
+    assert got == [b"twice", b"twice"]
+    assert hub.messages_duplicated == 1
+    assert hub.bytes_sent == 2 * len(b"twice")
+    got.clear()
+    hub.send(0, 1, b"first")
+    hub.send(0, 1, b"jump")  # jumps ahead of the most recently queued
+    hub.pump()
+    assert got == [b"jump", b"first"]
+    assert hub.messages_reordered == 1
+
+
+def test_hub_pump_cap_warns_instead_of_silently_truncating():
+    telemetry.reset()
+    hub = InMemoryHub()
+    hub.register(1, lambda src, data: None)
+    for _ in range(3):
+        hub.send(0, 1, b"m")
+    assert hub.pump(max_messages=1) == 1
+    assert hub.pump_capped == 1
+    assert hub.pending() == 2
+    counters = telemetry.snapshot("transport.pump_capped")["counters"]
+    assert counters["transport.pump_capped{transport=hub}"] == 1
+    # Draining the rest is quiescence, not a capped exit.
+    assert hub.pump() == 2
+    assert hub.pump_capped == 1
+    assert hub.pending() == 0
+    telemetry.reset()
+
+
+def test_recv_frame_oversize_closes_socket_and_counts_rejected():
+    """An oversize length prefix is unframeable garbage: the socket must be
+    deliberately closed (not left desynchronized mid-stream) and the event
+    counted under the tcp rejected series."""
+    telemetry.reset()
+    a, b = socket.socketpair()
+    try:
+        a.sendall((1 << 31).to_bytes(4, "big") + b"tail")
+        assert recv_frame(b) is None
+        assert b.fileno() == -1  # closed by recv_frame, not just drained
+        counters = telemetry.snapshot("transport.messages")["counters"]
+        assert counters["transport.messages{event=rejected,transport=tcp}"] == 1
+    finally:
+        a.close()
+        if b.fileno() != -1:
+            b.close()
+        telemetry.reset()
+
+
+def test_tcp_send_retries_with_backoff_before_failing():
+    telemetry.reset()
+    t = TCPTransport(
+        1, "127.0.0.1", 0, lambda s, d: None,
+        send_retries=2, send_backoff_s=0.01,
+    )
+    t.start()
+    try:
+        t.add_peer(2, "127.0.0.1", 1)  # nothing listens on port 1
+        t0 = time.monotonic()
+        assert t.send(2, b"x") is False
+        assert time.monotonic() - t0 < 5.0  # bounded, no hang
+        counters = telemetry.snapshot("transport.messages")["counters"]
+        assert counters["transport.messages{event=retry,transport=tcp}"] == 2
+        assert counters["transport.messages{event=send_failed,transport=tcp}"] == 1
+    finally:
+        t.stop()
+        telemetry.reset()
+
+
+def test_tcp_send_recovers_on_retry_when_listener_appears():
+    """A transient refusal (peer restarting) succeeds on a later attempt and
+    counts a retry, not a failure."""
+    telemetry.reset()
+    got = threading.Event()
+    srv = TCPTransport(2, "127.0.0.1", 0, lambda s, d: got.set())
+    t = TCPTransport(
+        1, "127.0.0.1", 0, lambda s, d: None,
+        send_retries=3, send_backoff_s=0.15,
+    )
+    t.start()
+    try:
+        # Reserve a port, point the sender at it while closed, then start
+        # the listener on it from a timer mid-backoff.
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        srv.port = port
+        t.add_peer(2, "127.0.0.1", port)
+        timer = threading.Timer(0.05, srv.start)
+        timer.start()
+        try:
+            assert t.send(2, b"x") is True
+        finally:
+            timer.join()
+        assert got.wait(5.0)
+        counters = telemetry.snapshot("transport.messages")["counters"]
+        assert counters.get("transport.messages{event=retry,transport=tcp}", 0) >= 1
+        assert counters.get("transport.messages{event=send_failed,transport=tcp}", 0) == 0
+    finally:
+        t.stop()
+        srv.stop()
+        telemetry.reset()
