@@ -328,6 +328,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="consecutive missed heartbeats before the failure detector "
         "suspects a peer (excluded from sampling and BRB quorums)",
     )
+    p.add_argument(
+        "--no-control-batching",
+        action="store_true",
+        help="use the v1 per-message BRB control framing instead of the "
+        "coalesced signed batch frames (wire v2); protocol outcomes are "
+        "identical, only message/signature counts differ",
+    )
+    p.add_argument(
+        "--no-pipeline",
+        action="store_true",
+        help="disable the pipelined round loop (eval/loss readbacks fetched "
+        "one round late); the record stream is bit-identical either way "
+        "minus duration_s",
+    )
     p.add_argument("--port", type=int, default=5000, help="HTTP port (serve mode)")
     p.add_argument("--n-devices", type=int, default=None, help="mesh size (default: all)")
     p.add_argument(
@@ -391,6 +405,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         brb_committee=args.brb_committee,
         round_timeout_s=args.round_timeout_s,
         suspicion_threshold=args.suspicion_threshold,
+        control_batching=not args.no_control_batching,
         seed=args.seed,
         compute_dtype=args.compute_dtype,
         param_dtype=args.param_dtype,
@@ -635,18 +650,14 @@ def main(argv: list[str] | None = None) -> int:
         log_path=args.log_path, n_devices=args.n_devices,
         checkpoint_dir=args.checkpoint_dir, checkpoint_every=args.checkpoint_every,
         profile_dir=args.profile_dir, failure_cooldown_rounds=args.failure_cooldown,
-        fault_plan=fault_plan,
+        fault_plan=fault_plan, pipeline=not args.no_pipeline,
     )
+    emit = lambda rec: print(json.dumps(rec.to_dict()), flush=True)  # noqa: E731
     with exp.profiler.trace():
         if args.fused_rounds > 0:
-            exp.run_fused(
-                rounds_per_call=args.fused_rounds,
-                on_record=lambda rec: print(json.dumps(rec.to_dict()), flush=True),
-            )
+            exp.run_fused(rounds_per_call=args.fused_rounds, on_record=emit)
         else:
-            while int(exp.state.round_idx) < cfg.rounds:
-                record = exp.run_round()
-                print(json.dumps(record.to_dict()))
+            exp.run_rounds(on_record=emit)
     exp.save_checkpoint()
     if args.trace_events:
         telemetry.write_trace(args.trace_events)

@@ -239,6 +239,14 @@ class Config:
     # (crash-recover peers re-join). Observational runtime state, never
     # checkpointed.
     suspicion_threshold: int = 2
+    # Coalesced control frames (wire v2): a committee member's echoes/readies
+    # for all of a round's concurrent BRB instances travel as ONE signed
+    # frame per (src, dst) pair per phase — one signature over the vote
+    # batch, verified once on receipt — dropping control messages per round
+    # from O(T * committee^2) toward O(committee^2) and signature operations
+    # proportionally. False restores the v1 per-message framing (kept for
+    # compatibility tests; protocol outcomes are identical either way).
+    control_batching: bool = True
 
     # Execution.
     seed: int = 42

@@ -38,6 +38,7 @@ deterministic global sync (vs. last-writer-wins), and a held-out eval split
 
 from __future__ import annotations
 
+import math
 from typing import Any, Callable
 
 import jax
@@ -1055,6 +1056,66 @@ def build_trust_round_fns(
             "dispatch.agg", jax.jit(agg_fn, donate_argnums=(0, 1, 2))
         ),
     )
+
+
+def build_digest_pack_fn(delta) -> tuple[Callable, Callable]:
+    """Single-transfer digesting: pack every trainer's update bytes into
+    ONE device buffer so the trust plane's digest step costs exactly one
+    ``jax.device_get`` per round.
+
+    ``delta`` is an example peer-stacked update tree (leaves ``[P, ...]``,
+    concrete or abstract) fixing the layout. Returns ``(pack_fn,
+    hash_row)``:
+
+    - ``pack_fn(delta, trainer_idx)``: jitted; for each leaf (in
+      ``tree_flatten_with_path`` order, the canonical ``digest_update``
+      order) gathers the ``[T]`` trainer rows, bitcasts to bytes, and
+      concatenates into a ``[T, total_bytes]`` uint8 buffer. All shapes
+      are static — varying trainer ids and ``-1`` vacancy padding never
+      retrigger XLA compilation after the first call. Vacant (``-1``)
+      slots are clamped to row 0 on device; the caller discards those
+      rows on the host.
+    - ``hash_row(row)``: host-side SHA-256 over one fetched row
+      interleaved with the canonical per-leaf headers
+      (``crypto.make_row_digester``) — bit-identical to
+      ``crypto.digest_update`` of that trainer's slice tree.
+
+    The byte layout relies on ``lax.bitcast_convert_type(x, uint8)``
+    emitting least-significant-byte-first along the new minor axis, which
+    matches ``np.ndarray.tobytes()`` on the little-endian hosts and TPUs
+    this runs on (asserted bit-for-bit by the digest-equivalence test).
+    """
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    from p2pdl_tpu.protocol.crypto import make_row_digester
+
+    leaves = tree_flatten_with_path(delta)[0]
+    if not leaves:
+        raise ValueError("cannot build a digest pack for an empty update tree")
+    num_peers = int(leaves[0][1].shape[0])
+    meta = []
+    for path, leaf in leaves:
+        row_shape = tuple(int(s) for s in leaf.shape[1:])
+        dtype = jnp.dtype(leaf.dtype)
+        nbytes = math.prod(row_shape) * dtype.itemsize
+        meta.append((keystr(path), row_shape, str(dtype), nbytes))
+    hash_row = make_row_digester(meta)
+
+    def pack(delta, trainer_idx):
+        # Clamp instead of letting a traced -1 wrap: the gathered bytes for
+        # a vacant slot are deterministic garbage (row 0) the host skips.
+        idx = jnp.clip(trainer_idx, 0, num_peers - 1)
+        rows = []
+        for _, leaf in tree_flatten_with_path(delta)[0]:
+            g = jnp.take(leaf, idx, axis=0)
+            flat = g.reshape((g.shape[0], -1))
+            b = lax.bitcast_convert_type(flat, jnp.uint8)
+            if b.ndim == 3:  # itemsize > 1 adds a trailing byte axis
+                b = b.reshape((flat.shape[0], -1))
+            rows.append(b)
+        return jnp.concatenate(rows, axis=1)
+
+    return telemetry.traced("dispatch.digest_pack", jax.jit(pack)), hash_row
 
 
 def build_gossip_trust_round_fns(

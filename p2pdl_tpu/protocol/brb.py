@@ -80,6 +80,46 @@ class BRBMessage:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class BRBBatch:
+    """One peer's coalesced echo/ready votes for every concurrent BRB
+    instance of a round (wire v2, ``Config.control_batching``).
+
+    With T trainers broadcasting per round, the per-message framing costs
+    O(T * committee^2) control frames and signatures; a batch carries the
+    (sender, digest) vote for all T instances in ONE frame per (src, dst)
+    pair per phase, under ONE signature covering the whole vote list —
+    verified once on receipt (``Broadcaster.handle_batch``), then each
+    vote advances its instance through the pre-verified path. Protocol
+    outcomes are identical to per-message framing: votes still land in
+    the same per-digest, one-vote-per-peer sets.
+    """
+
+    kind: str  # echo | ready (SEND carries a payload and travels alone)
+    from_id: int  # peer whose votes these are (and whose key signs)
+    seq: int  # broadcast sequence number (round index)
+    items: tuple[tuple[int, bytes], ...]  # (sender, digest) per instance
+    signature: Optional[bytes] = None  # over signing_bytes()
+
+    def signing_bytes(self) -> bytes:
+        parts = [
+            b"batch",
+            self.kind.encode(),
+            str(self.from_id).encode(),
+            str(self.seq).encode(),
+        ]
+        for sender, digest in self.items:
+            parts.append(str(sender).encode())
+            parts.append(digest)
+        return b"|".join(parts)
+
+
+# A batch larger than this is hostile (it could mint that many instances
+# in one frame) and is rejected outright; honest batches carry at most one
+# vote per concurrent broadcast, far below this.
+MAX_BATCH_ITEMS = 4096
+
+
 class BRBInstance:
     """One (sender, seq) broadcast as seen by one peer.
 
@@ -95,11 +135,24 @@ class BRBInstance:
     # quorum for one digest, so a small cap bounds a spamming sender.
     MAX_STORED_PAYLOADS = 4
 
-    def __init__(self, cfg: BRBConfig, my_id: int, key_server, private_key) -> None:
+    def __init__(
+        self,
+        cfg: BRBConfig,
+        my_id: int,
+        key_server,
+        private_key,
+        sign_control: bool = True,
+    ) -> None:
         self.cfg = cfg
         self.my_id = my_id
         self.key_server = key_server
         self.private_key = private_key
+        # With control batching, this peer's echoes/readies only ever
+        # travel inside a signed BRBBatch — the per-message signature would
+        # be dead weight (and the dominant host cost), so it is skipped.
+        # SENDs always carry their own signature: the payload travels once,
+        # per message, in both framings.
+        self.sign_control = sign_control
         self.payloads: dict[bytes, bytes] = {}
         self.accepted_digest: Optional[bytes] = None  # first valid SEND wins the echo
         self.echoes: dict[bytes, set[int]] = {}
@@ -118,6 +171,8 @@ class BRBInstance:
     def _make(self, kind: str, sender: int, seq: int, digest: bytes, payload=None) -> BRBMessage:
         telemetry.counter("brb.messages", kind=kind, dir="tx").inc()
         msg = BRBMessage(kind, sender, seq, self.my_id, digest, payload)
+        if kind != SEND and not self.sign_control:
+            return msg  # valid only inside a signed BRBBatch
         return dataclasses.replace(
             msg, signature=crypto.sign_data(self.private_key, msg.signing_bytes())
         )
@@ -150,6 +205,16 @@ class BRBInstance:
         if not crypto_ok(self.key_server, msg):
             telemetry.counter("brb.signature_failures", kind=msg.kind).inc()
             return []
+        return self._advance(msg)
+
+    def handle_preverified(self, msg: BRBMessage) -> list[BRBMessage]:
+        """Advance on a vote whose authenticity was already established by
+        the batch signature covering it (``Broadcaster.handle_batch``
+        verified the frame once); per-message crypto is skipped."""
+        telemetry.counter("brb.messages", kind=msg.kind, dir="rx").inc()
+        return self._advance(msg)
+
+    def _advance(self, msg: BRBMessage) -> list[BRBMessage]:
         out: list[BRBMessage] = []
 
         if msg.kind == SEND:
@@ -202,6 +267,12 @@ def crypto_ok(key_server, msg: BRBMessage) -> bool:
     return key_server.verify(msg.from_id, msg.signature, msg.signing_bytes())
 
 
+def batch_ok(key_server, batch: BRBBatch) -> bool:
+    if batch.signature is None:
+        return False
+    return key_server.verify(batch.from_id, batch.signature, batch.signing_bytes())
+
+
 class Broadcaster:
     """Per-peer BRB endpoint managing instances keyed by (sender, seq).
 
@@ -212,11 +283,19 @@ class Broadcaster:
     counters into each other.
     """
 
-    def __init__(self, cfg: BRBConfig, my_id: int, key_server, private_key) -> None:
+    def __init__(
+        self,
+        cfg: BRBConfig,
+        my_id: int,
+        key_server,
+        private_key,
+        sign_control: bool = True,
+    ) -> None:
         self.cfg = cfg
         self.my_id = my_id
         self.key_server = key_server
         self.private_key = private_key
+        self.sign_control = sign_control
         self.instances: dict[tuple[int, int], BRBInstance] = {}
 
     def reconfigure(self, cfg: BRBConfig) -> None:
@@ -232,7 +311,11 @@ class Broadcaster:
         key = (sender, seq)
         if key not in self.instances:
             self.instances[key] = BRBInstance(
-                self.cfg, self.my_id, self.key_server, self.private_key
+                self.cfg,
+                self.my_id,
+                self.key_server,
+                self.private_key,
+                sign_control=self.sign_control,
             )
         return self.instances[key]
 
@@ -255,6 +338,35 @@ class Broadcaster:
         if msg.kind not in (SEND, ECHO, READY):
             return []
         return self._instance(msg.sender, msg.seq).handle(msg)
+
+    def make_batch(self, kind: str, seq: int, items) -> BRBBatch:
+        """Coalesce this peer's (sender, digest) votes for one (kind, seq)
+        into a single signed frame (wire v2)."""
+        batch = BRBBatch(
+            kind=kind,
+            from_id=self.my_id,
+            seq=seq,
+            items=tuple((int(s), bytes(d)) for s, d in items),
+        )
+        return dataclasses.replace(
+            batch, signature=crypto.sign_data(self.private_key, batch.signing_bytes())
+        )
+
+    def handle_batch(self, batch: BRBBatch) -> list[BRBMessage]:
+        """Verify the batch signature ONCE, then advance every covered
+        instance through the pre-verified path. Duplicate or conflicting
+        votes inside a batch are bounded by each instance's
+        one-vote-per-peer caps, exactly as in the per-message framing."""
+        if batch.kind not in (ECHO, READY) or len(batch.items) > MAX_BATCH_ITEMS:
+            return []
+        if not batch_ok(self.key_server, batch):
+            telemetry.counter("brb.signature_failures", kind="batch").inc()
+            return []
+        out: list[BRBMessage] = []
+        for sender, digest in batch.items:
+            msg = BRBMessage(batch.kind, int(sender), batch.seq, batch.from_id, digest)
+            out.extend(self._instance(int(sender), batch.seq).handle_preverified(msg))
+        return out
 
     def delivered(self, sender: int, seq: int) -> Optional[bytes]:
         inst = self.instances.get((sender, seq))

@@ -125,6 +125,47 @@ def digest_update(update) -> bytes:
     return h.digest()
 
 
+def make_row_digester(leaf_meta):
+    """Per-row hasher for the single-transfer digest path, bit-compatible
+    with :func:`digest_update`.
+
+    ``leaf_meta`` is ``[(keystr, row_shape, dtype_str, nbytes), ...]`` in
+    ``tree_flatten_with_path`` order — one entry per leaf of the update
+    tree, describing a single trainer's slice (the peer axis removed).
+    The returned ``hash_row(row)`` takes one packed ``[total_bytes]``
+    uint8 buffer (that trainer's leaf slices concatenated in meta order,
+    each in C-contiguous little-endian layout, exactly what
+    ``parallel.round.build_digest_pack_fn`` produces) and interleaves the
+    canonical per-leaf header bytes — keystr + str(shape) + str(dtype) —
+    with the corresponding byte segments, so the digest is bitwise equal
+    to ``digest_update`` of that trainer's slice tree. The header bytes
+    and segment offsets are precomputed once; per row only SHA-256 runs
+    (which releases the GIL on large buffers, so rows thread-pool well).
+    """
+    segments: list[tuple[bytes, int, int]] = []
+    offset = 0
+    for key, row_shape, dtype_str, nbytes in leaf_meta:
+        header = key.encode() + str(tuple(row_shape)).encode() + dtype_str.encode()
+        segments.append((header, offset, offset + nbytes))
+        offset += nbytes
+    total = offset
+
+    def hash_row(row) -> bytes:
+        view = memoryview(np.ascontiguousarray(row)).cast("B")
+        if len(view) != total:
+            raise ValueError(
+                f"packed row has {len(view)} bytes, layout expects {total}"
+            )
+        h = hashlib.sha256()
+        for header, start, end in segments:
+            h.update(header)
+            h.update(view[start:end])
+        return h.digest()
+
+    hash_row.total_bytes = total
+    return hash_row
+
+
 def public_key_pem(public_key) -> bytes:
     if isinstance(public_key, _HmacPublicKey):
         return _HMAC_PEM_HEADER + public_key._secret.hex().encode() + _HMAC_PEM_FOOTER

@@ -31,10 +31,18 @@ import threading
 import time
 from typing import Callable, Optional
 
-from p2pdl_tpu.protocol.brb import BRBMessage
+from p2pdl_tpu.protocol.brb import BRBBatch, BRBMessage
 from p2pdl_tpu.utils import telemetry
 
 Handler = Callable[[int, bytes], None]  # (src_id, data) -> None
+
+# Control wire format version. v1: one JSON object per BRBMessage (no
+# version field). v2 adds the batched frame (`{"v": 2, "type": "batch"}`)
+# carrying a peer's echo/ready votes for all of a round's concurrent BRB
+# instances under one signature. v1 messages remain valid in v2 — SENDs
+# always travel per-message — and a v1-only receiver ignores batch frames
+# (they lack the "sender"/"digest" keys, so brb_from_wire returns None).
+CONTROL_WIRE_VERSION = 2
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 1 << 30
@@ -111,6 +119,44 @@ def brb_from_wire(data: bytes) -> Optional[BRBMessage]:
             digest=unb64(d["digest"]),
             payload=unb64(d.get("payload")),
             signature=unb64(d.get("signature")),
+        )
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
+def batch_to_wire(batch: BRBBatch) -> bytes:
+    def b64(x):
+        return base64.b64encode(x).decode() if x is not None else None
+
+    return json.dumps(
+        {
+            "v": CONTROL_WIRE_VERSION,
+            "type": "batch",
+            "kind": batch.kind,
+            "from_id": batch.from_id,
+            "seq": batch.seq,
+            "items": [[s, b64(d)] for s, d in batch.items],
+            "signature": b64(batch.signature),
+        }
+    ).encode()
+
+
+def control_from_wire(data: bytes):
+    """Parse either control frame shape: a v2 ``BRBBatch`` or a v1
+    ``BRBMessage``. None (not an exception) on malformed input."""
+    try:
+        d = json.loads(data)
+        if not isinstance(d, dict) or d.get("type") != "batch":
+            return brb_from_wire(data)
+        sig = d.get("signature")
+        return BRBBatch(
+            kind=str(d["kind"]),
+            from_id=int(d["from_id"]),
+            seq=int(d["seq"]),
+            items=tuple(
+                (int(s), base64.b64decode(dg)) for s, dg in d["items"]
+            ),
+            signature=base64.b64decode(sig) if sig is not None else None,
         )
     except (ValueError, KeyError, TypeError):
         return None

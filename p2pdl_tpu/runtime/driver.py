@@ -20,7 +20,9 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
 import jax
@@ -30,6 +32,7 @@ import numpy as np
 from p2pdl_tpu.config import Config
 from p2pdl_tpu.data import make_federated_data
 from p2pdl_tpu.parallel import (
+    build_digest_pack_fn,
     build_eval_fn,
     build_round_fn,
     build_gossip_trust_round_fns,
@@ -40,10 +43,15 @@ from p2pdl_tpu.parallel import (
     peer_sharding,
     shard_state,
 )
-from p2pdl_tpu.protocol.brb import BRBConfig, Broadcaster
-from p2pdl_tpu.protocol.crypto import KeyServer, digest_update, generate_key_pair
+from p2pdl_tpu.protocol.brb import BRBBatch, BRBConfig, Broadcaster
+from p2pdl_tpu.protocol.crypto import KeyServer, generate_key_pair
 from p2pdl_tpu.protocol.faults import FailureDetector, FaultInjector, resolve_plan
-from p2pdl_tpu.protocol.transport import InMemoryHub, brb_from_wire, brb_to_wire
+from p2pdl_tpu.protocol.transport import (
+    InMemoryHub,
+    batch_to_wire,
+    brb_to_wire,
+    control_from_wire,
+)
 from p2pdl_tpu.utils import telemetry
 from p2pdl_tpu.utils.metrics import MetricsLogger
 from p2pdl_tpu.utils.profiling import Profiler
@@ -115,6 +123,15 @@ class _TrustPlane:
         self.byz_ids = set(byz_ids)
         self.lie_digests: dict[int, bytes] = {}
         self.broadcasters: list[Broadcaster] = []
+        # Coalesced control frames (wire v2, cfg.control_batching): handler
+        # outputs accumulate per emitting peer per (kind, seq) and flush as
+        # ONE signed batch frame per (src, dst) pair per phase instead of
+        # one frame per vote — O(committee^2) frames per round instead of
+        # O(T * committee^2). With batching on, per-vote signatures are dead
+        # weight (the batch signature covers them), so the broadcasters skip
+        # them (sign_control=False); SENDs stay individually signed.
+        self.batching = bool(cfg.control_batching)
+        self._pending: dict[int, dict[tuple[str, int], list]] = {}
         if cfg.brb_committee and cfg.brb_committee < cfg.num_peers:
             rng = np.random.default_rng(cfg.seed)
             self.committee = sorted(
@@ -137,17 +154,35 @@ class _TrustPlane:
             priv, pub = generate_key_pair()
             self.key_server.register_key(pid, pub)
             self._keys.append(priv)
-            self.broadcasters.append(Broadcaster(brb_cfg, pid, self.key_server, priv))
+            self.broadcasters.append(
+                Broadcaster(
+                    brb_cfg, pid, self.key_server, priv,
+                    sign_control=not self.batching,
+                )
+            )
         for pid in self.committee:
             self.hub.register(pid, self._make_handler(pid))
 
     def _make_handler(self, pid: int):
         def handler(src: int, data: bytes) -> None:
-            msg = brb_from_wire(data)
+            msg = control_from_wire(data)
             if msg is None:
                 return
-            for out in self.broadcasters[pid].handle(msg):
-                self._fan_out(pid, out)
+            if isinstance(msg, BRBBatch):
+                outs = self.broadcasters[pid].handle_batch(msg)
+            else:
+                outs = self.broadcasters[pid].handle(msg)
+            if self.batching:
+                # Buffer this peer's reaction votes; run_round's pump/flush
+                # loop coalesces them into one signed frame per (kind, seq).
+                buf = self._pending.setdefault(pid, {})
+                for out in outs:
+                    buf.setdefault((out.kind, out.seq), []).append(
+                        (out.sender, out.digest)
+                    )
+            else:
+                for out in outs:
+                    self._fan_out(pid, out)
 
         return handler
 
@@ -158,8 +193,33 @@ class _TrustPlane:
         # every peer; suspected members get nothing (their links are dead
         # anyway — skipping them keeps control-message accounting honest).
         wire = brb_to_wire(msg)
+        telemetry.counter("control.frames", mode="per_message").inc(
+            len(self._live_committee)
+        )
         for dst in self._live_committee:
             self.hub.send(src, dst, wire)
+
+    def _flush_pending(self) -> int:
+        """Drain the vote buffer: one signed batch per (peer, kind, seq)
+        group, fanned out to the live committee. Returns frames sent."""
+        if not self._pending:
+            return 0
+        pending, self._pending = self._pending, {}
+        frames = 0
+        for pid, groups in pending.items():
+            for (kind, seq), items in groups.items():
+                batch = self.broadcasters[pid].make_batch(kind, seq, items)
+                wire = batch_to_wire(batch)
+                telemetry.counter("control.frames", mode="batched", kind=kind).inc(
+                    len(self._live_committee)
+                )
+                telemetry.counter("control.batched_digests", kind=kind).inc(
+                    len(items)
+                )
+                for dst in self._live_committee:
+                    self.hub.send(pid, dst, wire)
+                    frames += 1
+        return frames
 
     def _payload(self, round_idx: int, tid: int, digest: bytes) -> bytes:
         return json.dumps(
@@ -194,6 +254,7 @@ class _TrustPlane:
         committee config is kept (shrinking further would let f Byzantine
         voters forge a quorum, so the round is allowed to fail loudly
         instead)."""
+        self._pending.clear()  # no votes may leak across round boundaries
         live = [p for p in self.committee if p not in dark]
         if dark and len(live) > 3 * self.cfg.byzantine_f:
             live_cfg = BRBConfig(len(live), self.cfg.byzantine_f)
@@ -220,9 +281,16 @@ class _TrustPlane:
             else:
                 for msg in self.broadcasters[tid].broadcast(round_idx, payload):
                     self._fan_out(tid, msg)
+        # Pump to quiescence, alternating delivery with batch flushes: each
+        # pump drains the in-flight frames (handlers buffer their reaction
+        # votes under batching), each flush turns the buffered votes into
+        # the next wave of signed frames. Done when neither moves anything.
         deadline = time.monotonic() + self.cfg.round_timeout_s
-        while self.hub.pump() and time.monotonic() < deadline:
-            pass
+        while time.monotonic() < deadline:
+            delivered = self.hub.pump()
+            flushed = self._flush_pending()
+            if not delivered and not flushed:
+                break
         honest_trainers = [t for t in trainer_ids if t not in self.byz_ids]
         delivered_at = {
             tid: [
@@ -279,10 +347,25 @@ class Experiment:
         profile_dir: Optional[str] = None,
         failure_cooldown_rounds: int = 0,
         fault_plan: Optional[Any] = None,
+        pipeline: bool = True,
     ) -> None:
         self.cfg = cfg
         self.attack = attack
         self.byz_ids = tuple(byz_ids)
+        # Pipelined round loop (run_rounds/run): eval dispatches async and
+        # its scalars — plus the per-peer loss readback — are fetched one
+        # round late, so round r+1's device work overlaps round r's host
+        # record-keeping. The deferred readbacks land BEFORE round r+1
+        # samples roles (power_of_choice sees exactly the losses the
+        # synchronous loop would), at checkpoint boundaries, and at exit,
+        # so the RoundRecord stream is bit-identical (minus duration_s)
+        # with pipelining on or off. run_round() stays fully synchronous.
+        self.pipeline = bool(pipeline)
+        self._pending_round: Optional[dict] = None
+        # Single-transfer digesting state (lazy: built from the first
+        # round's delta tree).
+        self._digest_pack = None
+        self._digest_pool: Optional[ThreadPoolExecutor] = None
         # Chaos plane: a FaultPlan (object, scenario name, inline JSON, or
         # JSON file path) drives deterministic fault injection; the failure
         # detector always exists (empty suspicion set without faults) so
@@ -414,6 +497,11 @@ class Experiment:
             byz_gate[i] = 1.0
         self.byz_gate = jnp.asarray(byz_gate)
         self.records: list[RoundRecord] = []
+        # Host-side round counter mirroring state.round_idx — reading the
+        # device copy (int(self.state.round_idx)) would synchronize on the
+        # in-flight aggregate, which is exactly what the pipelined loop
+        # avoids. Resume-aware: starts at the restored round.
+        self._round_cursor = int(self.state.round_idx)
 
     def sample_roles(self, round_idx: Optional[int] = None) -> np.ndarray:
         """Random trainer sample per round (reference ``main.py:52-54``).
@@ -426,7 +514,7 @@ class Experiment:
         where the uninterrupted run would not — suspicion is observational,
         not part of the training state."""
         if round_idx is None:
-            round_idx = int(self.state.round_idx)
+            round_idx = self._round_cursor
         rng = np.random.default_rng([self.cfg.seed, round_idx])
         eligible = np.asarray(
             [
@@ -464,17 +552,46 @@ class Experiment:
             return np.sort(by_loss[:t])
         return np.sort(rng.choice(eligible, t, replace=False))
 
-    def _run_trust_plane(self, r: int, live: np.ndarray, delta) -> tuple:
+    def _run_trust_plane(
+        self, r: int, live: np.ndarray, delta, padded: Optional[np.ndarray] = None
+    ) -> tuple:
         """Digest each live trainer's on-device delta, BRB-broadcast the
         commitments, account control traffic, and feed the failure detector
         (both receiver failures and excluded senders enter cooldown).
-        Returns ``(delivered, failed, excluded, verified, msgs, nbytes)``."""
-        digests = {
-            int(t): digest_update(
-                jax.tree.map(lambda d, t=t: np.asarray(d[int(t)]), delta)
+        Returns ``(delivered, failed, excluded, verified, msgs, nbytes)``.
+
+        Single-transfer digesting: the per-trainer, per-leaf ``np.asarray``
+        gathers of earlier builds cost one device->host transfer per (leaf,
+        trainer) — O(T * leaves) blocking round trips. Here a jitted pack
+        step (``parallel.build_digest_pack_fn``) flattens every trainer's
+        delta into one contiguous ``[T, total_bytes]`` device buffer, ONE
+        ``jax.device_get`` moves it, and the per-row SHA-256 (bit-identical
+        to ``crypto.digest_update``) runs on a small host thread pool —
+        sha256 releases the GIL on large buffers, so rows hash in parallel.
+
+        ``padded`` is the round's full trainer vector including -1 vacancy
+        slots (the pack function needs a static shape; vacant rows are
+        packed-then-skipped); default ``live`` when there is no padding.
+        """
+        if padded is None:
+            padded = live
+        if self._digest_pack is None:
+            self._digest_pack = build_digest_pack_fn(delta)
+        pack_fn, hash_row = self._digest_pack
+        padded_host = np.asarray(padded)
+        packed = pack_fn(delta, jnp.asarray(padded_host, jnp.int32))
+        buf = np.asarray(jax.device_get(packed))  # the round's one D2H
+        telemetry.counter("driver.d2h_transfers").inc()
+        if self._digest_pool is None:
+            self._digest_pool = ThreadPoolExecutor(
+                max_workers=min(8, os.cpu_count() or 1)
             )
-            for t in live
+        futures = {
+            int(t): self._digest_pool.submit(hash_row, buf[i])
+            for i, t in enumerate(padded_host)
+            if t >= 0
         }
+        digests = {t: f.result() for t, f in futures.items()}
         m0, b0 = self.trust.hub.messages_sent, self.trust.hub.bytes_sent
         delivered, failed, verified = self.trust.run_round(
             r, live.tolist(), digests, dark=frozenset(self.detector.suspected)
@@ -554,10 +671,27 @@ class Experiment:
         return recovered
 
     def run_round(self, trainers: Optional[np.ndarray] = None) -> RoundRecord:
-        """Run one round. ``trainers`` overrides role sampling (the Cluster
-        facade passes the set its Nodes consented to, reference
+        """Run one round, fully synchronously: any deferred readbacks from
+        a pipelined loop are flushed first and this round's record is
+        materialized before returning. ``trainers`` overrides role sampling
+        (the Cluster facade passes the set its Nodes consented to, reference
         ``main.py:59-76``); default samples per ``sample_roles``."""
-        r = int(self.state.round_idx)
+        return self._run_one_round(trainers, defer=False)
+
+    def _run_one_round(
+        self, trainers: Optional[np.ndarray] = None, defer: bool = False
+    ) -> Optional[RoundRecord]:
+        """Dispatch one round. With ``defer=True`` the host-blocking
+        readbacks (per-peer losses, eval scalars) are parked in
+        ``_pending_round`` and resolved by the NEXT call (or an explicit
+        flush) — by then the device has finished them, so the fetch is
+        free, and round r+1's device work overlaps round r's host tail.
+        Returns the round's record, or None when deferred."""
+        # Resolve round r-1 BEFORE this round's chaos/sampling: the flush
+        # sets _peer_losses, so power_of_choice samples round r from exactly
+        # the losses the synchronous loop would have seen.
+        self._flush_pending_round()
+        r = self._round_cursor
         fault_events = suspected_now = excluded_now = None
         if self.faults is not None:
             fault_events = self.faults.begin_round(r)
@@ -613,6 +747,8 @@ class Experiment:
         t0 = time.perf_counter()
         brb_delivered = brb_failed = brb_excluded = msgs = nbytes = None
         mask_recoveries = None
+        loss_scope = "live"  # mean over live trainers vs every peer
+        set_peer_losses = True  # gossip-gated never fed biased selection
         if self._gated:
             if (
                 self.secure_keyring is not None
@@ -649,15 +785,12 @@ class Experiment:
                 delta, new_opt, losses_dev = self.train_fn(
                     self.state, self.x, self.y, self.byz_gate, mask_key
                 )
-                self._peer_losses = np.asarray(losses_dev)  # [P]
-                losses = self._peer_losses[live]
-                train_loss = float(np.mean(losses))
             with self.profiler.phase(
                 "brb", round=r, trainers=len(live),
                 committee=len(self.trust.committee),
             ):
                 brb_delivered, brb_failed, brb_excluded, verified, msgs, nbytes = (
-                    self._run_trust_plane(r, live, delta)
+                    self._run_trust_plane(r, live, delta, padded=trainers)
                 )
                 if self.cfg.aggregator in ("fedavg", "secure_fedavg"):
                     # Gate: a trainer whose commitment did not deliver+verify
@@ -727,11 +860,12 @@ class Experiment:
             # peer's weight is zeroed in every neighbor's mixing row, so its
             # (possibly corrupted) params never enter any honest peer's
             # round-r mix — exclusion is in-round, not one round late.
+            loss_scope = "all"
+            set_peer_losses = False
             with self.profiler.phase("round", round=r, trainers=self.cfg.num_peers):
                 attacked, new_opt, losses_dev, delta = self.train_fn(
                     self.state, self.x, self.y, self.byz_gate, mask_key
                 )
-                train_loss = float(np.mean(np.asarray(losses_dev)))
             with self.profiler.phase(
                 "brb", round=r, trainers=self.cfg.num_peers,
                 committee=len(self.trust.committee),
@@ -766,34 +900,84 @@ class Experiment:
                 # is trainer loss (``main.py:90-94`` collects from trainer
                 # runs). Gossip has no roles: every peer trains, so every
                 # loss counts.
-                losses = np.asarray(m["train_loss"])
-                self._peer_losses = losses  # [P] — feeds biased selection
-                if self.cfg.aggregator != "gossip":
-                    losses = losses[live]
-                train_loss = float(np.mean(losses))
+                losses_dev = m["train_loss"]  # [P] device array
+                if self.cfg.aggregator == "gossip":
+                    loss_scope = "all"
 
         with self.profiler.phase("eval", round=r):
+            # Async dispatch: ev holds device scalars; forcing them here
+            # would stall the host on the whole round's device chain, so the
+            # float() readbacks happen at flush time, one round late.
             ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)
-        record = RoundRecord(
-            round=r,
-            trainers=live.tolist(),
-            train_loss=train_loss,
-            eval_loss=float(ev["eval_loss"]),
-            eval_acc=float(ev["eval_acc"]),
-            duration_s=time.perf_counter() - t0,
-            brb_delivered=brb_delivered,
-            brb_failed_peers=brb_failed,
-            brb_excluded_trainers=brb_excluded,
-            control_messages=msgs,
-            control_bytes=nbytes,
-            dp_epsilon=self._dp_epsilon(r + 1),
-            fault_events=fault_events,
-            suspected_peers=suspected_now,
-            excluded_peers=excluded_now,
-            faults_injected=(
+        # duration_s is measured at the dispatch/defer point (and is the one
+        # field excluded from the bit-identity contract, see RoundRecord).
+        self._pending_round = {
+            "r": r,
+            "live": live,
+            "losses_dev": losses_dev,
+            "loss_scope": loss_scope,
+            "set_peer_losses": set_peer_losses,
+            "ev": ev,
+            "duration_s": time.perf_counter() - t0,
+            "brb_delivered": brb_delivered,
+            "brb_failed": brb_failed,
+            "brb_excluded": brb_excluded,
+            "msgs": msgs,
+            "nbytes": nbytes,
+            "dp_epsilon": self._dp_epsilon(r + 1),
+            "fault_events": fault_events,
+            "suspected_now": suspected_now,
+            "excluded_now": excluded_now,
+            "faults_injected": (
                 dict(self.faults.round_injected) if self.faults is not None else None
             ),
-            mask_recoveries=mask_recoveries,
+            "mask_recoveries": mask_recoveries,
+        }
+        self._round_cursor = r + 1
+        boundary = (
+            self.checkpointer is not None and (r + 1) % self.checkpoint_every == 0
+        )
+        record = None
+        if not defer or boundary:
+            # Checkpoint boundaries flush first so the saved state never
+            # runs ahead of the recorded stream (sync-mode ordering).
+            record = self._flush_pending_round()
+        else:
+            telemetry.gauge("driver.pipeline_depth").set(1)
+        if boundary:
+            self.checkpointer.save(self.state, self.cfg, extra=self._ckpt_extra)
+        return record
+
+    def _flush_pending_round(self) -> Optional[RoundRecord]:
+        """Resolve the deferred readbacks of the previously dispatched
+        round into its RoundRecord; no-op (None) when nothing is pending."""
+        p, self._pending_round = self._pending_round, None
+        if p is None:
+            return None
+        telemetry.gauge("driver.pipeline_depth").set(0)
+        losses = np.asarray(p["losses_dev"])  # [P]
+        if p["set_peer_losses"]:
+            self._peer_losses = losses  # feeds biased selection
+        row = losses if p["loss_scope"] == "all" else losses[p["live"]]
+        ev = p["ev"]
+        record = RoundRecord(
+            round=p["r"],
+            trainers=p["live"].tolist(),
+            train_loss=float(np.mean(row)),
+            eval_loss=float(ev["eval_loss"]),
+            eval_acc=float(ev["eval_acc"]),
+            duration_s=p["duration_s"],
+            brb_delivered=p["brb_delivered"],
+            brb_failed_peers=p["brb_failed"],
+            brb_excluded_trainers=p["brb_excluded"],
+            control_messages=p["msgs"],
+            control_bytes=p["nbytes"],
+            dp_epsilon=p["dp_epsilon"],
+            fault_events=p["fault_events"],
+            suspected_peers=p["suspected_now"],
+            excluded_peers=p["excluded_now"],
+            faults_injected=p["faults_injected"],
+            mask_recoveries=p["mask_recoveries"],
         )
         # Compile/steady split: this PROCESS's first round pays jit tracing
         # + XLA compilation (whatever round index a resumed run starts at);
@@ -806,8 +990,6 @@ class Experiment:
             telemetry.histogram("driver.steady_round_s").observe(record.duration_s)
         self.records.append(record)
         self.metrics.log(record.to_dict())
-        if self.checkpointer is not None and (r + 1) % self.checkpoint_every == 0:
-            self.checkpointer.save(self.state, self.cfg, extra=self._ckpt_extra)
         return record
 
     def per_peer_accuracy(self) -> np.ndarray:
@@ -876,6 +1058,7 @@ class Experiment:
             self._multi_round_fn = build_multi_round_fn(
                 self.cfg, self.mesh, attack=self.attack
             )
+        self._flush_pending_round()  # a prior pipelined loop may have a tail
         base_key = jax.random.PRNGKey(self.cfg.seed)
         while int(self.state.round_idx) < self.cfg.rounds:
             r0 = int(self.state.round_idx)
@@ -924,6 +1107,7 @@ class Experiment:
                 (r0 + block) // self.checkpoint_every > r0 // self.checkpoint_every
             ):
                 self.checkpointer.save(self.state, self.cfg, extra=self._ckpt_extra)
+        self._round_cursor = int(self.state.round_idx)
         self.save_checkpoint()
         return self.records
 
@@ -956,7 +1140,34 @@ class Experiment:
             "final_eval_acc": self.records[-1].eval_acc if self.records else None,
         }
 
-    def run(self) -> list[RoundRecord]:
+    def run_rounds(self, on_record: Optional[Any] = None) -> list[RoundRecord]:
+        """The round loop alone (no profiler trace, no final checkpoint —
+        callers that wrap their own trace context, like the CLI, use this).
+
+        With ``self.pipeline`` (the default) rounds are dispatched one
+        ahead: round r's loss/eval readbacks resolve while round r+1's
+        device work runs, and the tail round is flushed explicitly before
+        returning — the record stream is bit-identical (minus duration_s)
+        to the synchronous loop. ``on_record`` is called with each record
+        as it materializes (one round late under pipelining)."""
+        emitted = len(self.records)
+
+        def emit() -> int:
+            n = emitted
+            while n < len(self.records):
+                if on_record is not None:
+                    on_record(self.records[n])
+                n += 1
+            return n
+
+        while self._round_cursor < self.cfg.rounds:
+            self._run_one_round(defer=self.pipeline)
+            emitted = emit()
+        self._flush_pending_round()
+        emit()
+        return self.records
+
+    def run(self, on_record: Optional[Any] = None) -> list[RoundRecord]:
         """Run the remaining rounds (resume-aware: a restored experiment
         continues from its checkpointed round, reference has no equivalent).
 
@@ -966,8 +1177,7 @@ class Experiment:
         ``profile_dir`` when configured (the ``jax.profiler`` trace wraps the
         whole run here, not only in the CLI)."""
         with self.profiler.trace():
-            while int(self.state.round_idx) < self.cfg.rounds:
-                self.run_round()
+            self.run_rounds(on_record)
         self.save_checkpoint()
         return self.records
 

@@ -202,6 +202,16 @@ class _NoopMetric:
 _NOOP = _NoopMetric()
 
 
+# Per-metric labeled-series ceiling: per-peer series (e.g.
+# ``driver.brb_delivery_failures{peer=...}``) are O(num_peers), which at
+# 1024+ simulated peers would grow the registry without bound. Past the cap
+# the overflow folds into one ``__other__`` series per metric, so memory is
+# bounded while the aggregate count stays exact. Override per registry or
+# via ``P2PDL_TELEMETRY_MAX_SERIES``.
+DEFAULT_MAX_SERIES_PER_METRIC = 2048
+OVERFLOW_LABEL = "__other__"
+
+
 class MetricsRegistry:
     """Process-wide labeled metric series.
 
@@ -210,34 +220,77 @@ class MetricsRegistry:
     returned object is then incremented lock-free — int ops under the GIL
     are the documented best-effort concurrency contract, the same one the
     hub's inline attributes always had.
+
+    Cardinality: each metric name admits at most ``max_series_per_metric``
+    distinct labeled series; further label combinations resolve to that
+    metric's ``__other__`` fold series and each redirected lookup counts
+    one ``telemetry.series_dropped{metric=...}`` event.
     """
 
-    def __init__(self, enabled: bool = True) -> None:
+    def __init__(
+        self,
+        enabled: bool = True,
+        max_series_per_metric: Optional[int] = None,
+    ) -> None:
         self.enabled = enabled
+        if max_series_per_metric is None:
+            max_series_per_metric = int(
+                os.environ.get(
+                    "P2PDL_TELEMETRY_MAX_SERIES", DEFAULT_MAX_SERIES_PER_METRIC
+                )
+            )
+        self.max_series_per_metric = max_series_per_metric
         self._lock = threading.Lock()
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}
+        # Labeled-series count per metric name (unlabeled series are exempt:
+        # there is exactly one of them per name).
+        self._label_counts: dict[str, int] = {}
 
-    def _series(self, table: dict, cls, key: str, *args):
+    def _series(self, table: dict, cls, name: str, labels: dict, *args):
+        key = series_key(name, labels)
         metric = table.get(key)
-        if metric is None:
-            with self._lock:
-                metric = table.get(key)
-                if metric is None:
+        if metric is not None:
+            return metric
+        folded = False
+        with self._lock:
+            metric = table.get(key)
+            if metric is None:
+                if (
+                    labels
+                    and self._label_counts.get(name, 0) >= self.max_series_per_metric
+                ):
+                    # Cap hit: redirect to the metric's fold series instead
+                    # of minting a new one (the fold itself does not count
+                    # toward the cap, so it is always reachable).
+                    folded = True
+                    key = series_key(name, {k: OVERFLOW_LABEL for k in labels})
+                    metric = table.get(key)
+                    if metric is None:
+                        metric = cls(*args)
+                        table[key] = metric
+                else:
                     metric = cls(*args)
                     table[key] = metric
+                    if labels:
+                        self._label_counts[name] = self._label_counts.get(name, 0) + 1
+        if folded:
+            # Outside the lock: counter() re-enters _series and the lock is
+            # non-reentrant. Counts fold events (redirected lookups), the
+            # signal that a metric's label space outgrew the cap.
+            self.counter("telemetry.series_dropped", metric=name).inc()
         return metric
 
     def counter(self, name: str, **labels: Any) -> Counter:
         if not self.enabled:
             return _NOOP  # type: ignore[return-value]
-        return self._series(self._counters, Counter, series_key(name, labels))
+        return self._series(self._counters, Counter, name, labels)
 
     def gauge(self, name: str, **labels: Any) -> Gauge:
         if not self.enabled:
             return _NOOP  # type: ignore[return-value]
-        return self._series(self._gauges, Gauge, series_key(name, labels))
+        return self._series(self._gauges, Gauge, name, labels)
 
     def histogram(
         self,
@@ -247,9 +300,7 @@ class MetricsRegistry:
     ) -> Histogram:
         if not self.enabled:
             return _NOOP  # type: ignore[return-value]
-        return self._series(
-            self._histograms, Histogram, series_key(name, labels), bounds
-        )
+        return self._series(self._histograms, Histogram, name, labels, bounds)
 
     def snapshot(self, prefix: str = "") -> dict[str, dict[str, Any]]:
         """JSON-ready dump ``{counters, gauges, histograms}``; ``prefix``
@@ -278,6 +329,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._histograms.clear()
+            self._label_counts.clear()
 
 
 class _Span:

@@ -1,0 +1,399 @@
+"""Control-plane fast path: single-transfer digesting, coalesced BRB
+frames, and the pipelined round loop.
+
+Three layers under test:
+
+- ``parallel.build_digest_pack_fn`` + ``crypto.make_row_digester``: the
+  packed single-transfer digests must be BIT-identical to the canonical
+  ``crypto.digest_update`` of each trainer's slice tree, across dtypes,
+  vacancy (-1) padding, and sharded inputs — and the pack step must never
+  retrigger XLA compilation after its first call.
+- ``_TrustPlane`` control batching (wire v2): one signed frame per
+  (src, dst) pair per phase must cut hub messages per BRB round >= 3x at
+  committee >= 8 while preserving every BRB safety property (equivocator
+  exclusion, forged-frame rejection, one-vote-per-peer) in BOTH framings.
+- The pipelined driver loop: deferred loss/eval readbacks must leave the
+  RoundRecord stream bit-identical (minus duration_s) to the synchronous
+  loop, including under a seeded chaos FaultPlan.
+
+Driver-level tests need the compiled round programs and are skipped where
+``jax.shard_map`` is unavailable (same convention as test_chaos; set
+``P2PDL_JAX_COMPAT=1`` for the shims).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.parallel import build_digest_pack_fn, peer_sharding
+from p2pdl_tpu.protocol import brb as brb_mod
+from p2pdl_tpu.protocol.brb import BRBBatch, BRBConfig, Broadcaster, ECHO, SEND
+from p2pdl_tpu.protocol.crypto import KeyServer, digest_update, generate_key_pair
+from p2pdl_tpu.protocol.transport import (
+    batch_to_wire,
+    brb_to_wire,
+    control_from_wire,
+)
+from p2pdl_tpu.runtime.driver import Experiment, _TrustPlane
+from p2pdl_tpu.utils import telemetry
+from p2pdl_tpu.utils.telemetry import MetricsRegistry
+
+requires_spmd = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="driver needs jax.shard_map (set P2PDL_JAX_COMPAT=1 for the shims)",
+)
+
+
+# ---------------------------------------------------------------------------
+# Single-transfer digesting: bit-compatibility with digest_update
+# ---------------------------------------------------------------------------
+
+
+def _delta_tree(num_peers: int, seed: int = 0):
+    """A peer-stacked update tree mixing dtypes, ranks, and a scalar-per-peer
+    leaf (row shape ()) — the shapes the digest pack must serialize exactly
+    as ``np.ascontiguousarray(arr).tobytes()`` would."""
+    rng = np.random.default_rng(seed)
+    return {
+        "dense": {
+            "w": jnp.asarray(rng.normal(size=(num_peers, 4, 3)).astype(np.float32)),
+            "b": jnp.asarray(rng.normal(size=(num_peers, 3)).astype(np.float32)),
+        },
+        "head_bf16": jnp.asarray(
+            rng.normal(size=(num_peers, 5)).astype(np.float32)
+        ).astype(jnp.bfloat16),
+        "gate_f16": jnp.asarray(rng.normal(size=(num_peers, 2, 2)).astype(np.float16)),
+        "count_i8": jnp.asarray(
+            rng.integers(-100, 100, size=(num_peers, 7)).astype(np.int8)
+        ),
+        "scale": jnp.asarray(rng.normal(size=(num_peers,)).astype(np.float32)),
+    }
+
+
+def _reference_digest(delta, t: int) -> bytes:
+    """The canonical per-trainer digest the old per-leaf path produced."""
+    return digest_update(jax.tree.map(lambda d: np.asarray(d)[t], delta))
+
+
+def test_packed_digests_match_digest_update():
+    delta = _delta_tree(8)
+    pack_fn, hash_row = build_digest_pack_fn(delta)
+    trainers = np.array([1, 3, 6], np.int32)
+    buf = np.asarray(jax.device_get(pack_fn(delta, jnp.asarray(trainers))))
+    assert buf.dtype == np.uint8 and buf.shape == (3, hash_row.total_bytes)
+    for i, t in enumerate(trainers):
+        assert hash_row(buf[i]) == _reference_digest(delta, int(t))
+
+
+def test_packed_digests_skip_vacancy_padding():
+    """-1 slots are clamped on device (static shape, no recompile) and
+    skipped on host; the live rows still hash bit-exact."""
+    delta = _delta_tree(8, seed=3)
+    pack_fn, hash_row = build_digest_pack_fn(delta)
+    padded = np.array([2, 5, -1], np.int32)
+    buf = np.asarray(jax.device_get(pack_fn(delta, jnp.asarray(padded))))
+    assert buf.shape[0] == 3  # vacancy rows are packed (clamped), not dropped
+    for i, t in enumerate(padded):
+        if t >= 0:
+            assert hash_row(buf[i]) == _reference_digest(delta, int(t))
+
+
+def test_packed_digests_match_on_sharded_delta(mesh8):
+    """Peer-sharded device arrays (the layout the gated round actually
+    hands over) digest identically to their host copies."""
+    delta = _delta_tree(8, seed=7)
+    sharded = jax.tree.map(lambda d: jax.device_put(d, peer_sharding(mesh8)), delta)
+    pack_fn, hash_row = build_digest_pack_fn(sharded)
+    trainers = np.array([0, 4, 7], np.int32)
+    buf = np.asarray(jax.device_get(pack_fn(sharded, jnp.asarray(trainers))))
+    for i, t in enumerate(trainers):
+        assert hash_row(buf[i]) == _reference_digest(delta, int(t))
+
+
+def test_pack_fn_single_compile_across_trainer_sets():
+    """Varying trainer ids and vacancy counts reuse one executable: the
+    trainer vector is a traced [T] argument, never a static shape."""
+    delta = _delta_tree(8, seed=1)
+    pack_fn, _ = build_digest_pack_fn(delta)
+    for idx in ([1, 3, 6], [0, -1, -1], [2, 5, -1], [7, 7, 7]):
+        pack_fn(delta, jnp.asarray(np.array(idx, np.int32)))
+    assert pack_fn.__wrapped__._cache_size() == 1
+
+
+def test_empty_update_tree_rejected():
+    with pytest.raises(ValueError, match="empty update tree"):
+        build_digest_pack_fn({})
+
+
+# ---------------------------------------------------------------------------
+# Coalesced control frames (wire v2)
+# ---------------------------------------------------------------------------
+
+# Committee of 9 with 5 trainers: per-message framing costs ~T*m + 2*T*m^2
+# hub sends, batching ~T*m + 2*m^2 — ratio ~4.1x, comfortably past the 3x
+# budget this suite enforces. (At T=3 the ratio dips below 3x: the SEND
+# term T*m is framing-invariant, so small rounds amortize less.)
+BUDGET_CFG = Config(
+    num_peers=16,
+    trainers_per_round=5,
+    byzantine_f=2,
+    brb_enabled=True,
+    brb_committee=9,
+    rounds=1,
+    samples_per_peer=32,
+    batch_size=32,
+)
+
+
+def _fake_digests(trainers):
+    return {int(t): bytes([t % 256]) * 32 for t in trainers}
+
+
+def _trainers_for(cfg):
+    """Deterministic trainer set for direct _TrustPlane rounds."""
+    rng = np.random.default_rng(1234)
+    return sorted(
+        int(p) for p in rng.choice(cfg.num_peers, cfg.trainers_per_round, replace=False)
+    )
+
+
+def test_control_batching_cuts_messages_3x():
+    batched = _TrustPlane(BUDGET_CFG)
+    unbatched = _TrustPlane(dataclasses.replace(BUDGET_CFG, control_batching=False))
+    trainers = _trainers_for(BUDGET_CFG)
+    digests = _fake_digests(trainers)
+
+    delivered_b, failed_b, verified_b = batched.run_round(0, trainers, digests)
+    delivered_u, failed_u, verified_u = unbatched.run_round(0, trainers, digests)
+
+    # Same protocol outcome either way...
+    assert (delivered_b, failed_b, sorted(verified_b)) == (
+        delivered_u,
+        failed_u,
+        sorted(verified_u),
+    )
+    assert sorted(verified_b) == trainers
+    # ...at >= 3x fewer hub messages (the ledger the records report).
+    assert batched.hub.messages_sent * 3 <= unbatched.hub.messages_sent
+    assert batched.hub.messages_sent > 0
+
+
+@pytest.mark.parametrize("batching", [True, False])
+def test_equivocator_excluded_in_both_framings(batching):
+    cfg = dataclasses.replace(BUDGET_CFG, control_batching=batching)
+    trainers = _trainers_for(cfg)
+    byz = trainers[0]
+    plane = _TrustPlane(cfg, byz_ids=(byz,))
+    delivered, failed, verified = plane.run_round(
+        0, trainers, _fake_digests(trainers)
+    )
+    assert byz not in verified
+    assert sorted(verified) == trainers[1:]
+
+
+@pytest.mark.parametrize("batching", [True, False])
+def test_lying_trainer_excluded_in_both_framings(batching):
+    """A consistent-but-false commitment delivers fine and fails verify."""
+    cfg = dataclasses.replace(BUDGET_CFG, control_batching=batching)
+    plane = _TrustPlane(cfg)
+    trainers = _trainers_for(cfg)
+    liar = trainers[-1]
+    plane.lie_digests[liar] = b"\xaa" * 32
+    _, _, verified = plane.run_round(0, trainers, _fake_digests(trainers))
+    assert liar not in verified
+    assert sorted(verified) == trainers[:-1]
+
+
+def _small_net(n=4, f=1):
+    ks = KeyServer()
+    privs = []
+    for pid in range(n):
+        priv, pub = generate_key_pair()
+        ks.register_key(pid, pub)
+        privs.append(priv)
+    cfg = BRBConfig(n, f)
+    return ks, [
+        Broadcaster(cfg, pid, ks, privs[pid], sign_control=False)
+        for pid in range(n)
+    ]
+
+
+def test_forged_batch_signature_rejected():
+    ks, bcs = _small_net()
+    victim, attacker = 1, 2
+    forged = BRBBatch(
+        kind=ECHO,
+        from_id=victim,  # claims the victim's votes...
+        seq=0,
+        items=((0, b"\x01" * 32),),
+        signature=bcs[attacker].make_batch(ECHO, 0, [(0, b"\x01" * 32)]).signature,
+    )  # ...under the attacker's signature
+    assert bcs[3].handle_batch(forged) == []
+    inst = bcs[3].instances.get((0, 0))
+    assert inst is None or not inst.echoes  # no vote landed
+
+
+def test_unsigned_batch_rejected():
+    _, bcs = _small_net()
+    naked = BRBBatch(kind=ECHO, from_id=1, seq=0, items=((0, b"\x01" * 32),))
+    assert bcs[3].handle_batch(naked) == []
+
+
+def test_batch_replay_votes_count_once():
+    _, bcs = _small_net()
+    digest = b"\x02" * 32
+    batch = bcs[1].make_batch(ECHO, 0, [(0, digest)])
+    bcs[3].handle_batch(batch)
+    bcs[3].handle_batch(batch)  # replay
+    inst = bcs[3].instances[(0, 0)]
+    assert inst.echoes[digest] == {1}
+
+
+def test_oversize_batch_rejected():
+    _, bcs = _small_net()
+    items = [(s, bytes([s % 256]) * 32) for s in range(brb_mod.MAX_BATCH_ITEMS + 1)]
+    batch = bcs[1].make_batch(ECHO, 0, items)
+    assert bcs[3].handle_batch(batch) == []
+
+
+def test_batch_wire_roundtrip_and_v1_coexistence():
+    _, bcs = _small_net()
+    batch = bcs[1].make_batch(ECHO, 5, [(0, b"\x03" * 32), (2, b"\x04" * 32)])
+    back = control_from_wire(batch_to_wire(batch))
+    assert back == batch
+    # v1 per-message frames still parse through the same entry point.
+    out = bcs[0].broadcast(5, b"payload")[0]
+    assert out.kind == SEND
+    assert control_from_wire(brb_to_wire(out)) == out
+    # Garbage stays a None, not an exception.
+    assert control_from_wire(b'{"type": "batch", "items": 7}') is None
+    assert control_from_wire(b"\xff\xfe not json") is None
+
+
+# ---------------------------------------------------------------------------
+# Telemetry cardinality cap
+# ---------------------------------------------------------------------------
+
+
+def test_series_cardinality_cap_folds_overflow():
+    reg = MetricsRegistry(max_series_per_metric=4)
+    for peer in range(6):
+        reg.counter("test.per_peer", peer=peer).inc()
+    keys = [k for k in reg._counters if k.startswith("test.per_peer")]
+    assert len(keys) == 5  # 4 distinct + the __other__ fold
+    assert "test.per_peer{peer=__other__}" in keys
+    # The fold absorbed both overflow increments...
+    assert reg._counters["test.per_peer{peer=__other__}"].value == 2
+    # ...and each redirected lookup was counted.
+    assert (
+        reg._counters["telemetry.series_dropped{metric=test.per_peer}"].value == 2
+    )
+    # Unlabeled series are exempt from the cap.
+    reg.counter("test.unlabeled").inc()
+    assert reg._counters["test.unlabeled"].value == 1
+
+
+def test_series_cap_resolves_existing_series_past_cap():
+    """Hitting the cap must not cut off series created BEFORE it."""
+    reg = MetricsRegistry(max_series_per_metric=2)
+    reg.counter("m", peer=0).inc()
+    reg.counter("m", peer=1).inc()
+    reg.counter("m", peer=2).inc()  # folds
+    reg.counter("m", peer=0).inc()  # pre-cap series still resolves
+    assert reg._counters["m{peer=0}"].value == 2
+    assert reg._counters["m{peer=__other__}"].value == 1
+
+
+def test_series_cap_reset_clears_counts():
+    reg = MetricsRegistry(max_series_per_metric=1)
+    reg.counter("m", peer=0).inc()
+    reg.counter("m", peer=1).inc()  # folds
+    reg.reset()
+    reg.counter("m", peer=1).inc()  # budget restored after reset
+    assert reg._counters["m{peer=1}"].value == 1
+
+
+# ---------------------------------------------------------------------------
+# Driver integration: one D2H per round, no recompiles, pipelined identity
+# ---------------------------------------------------------------------------
+
+DRIVER_CFG = Config(
+    num_peers=8,
+    trainers_per_round=3,
+    rounds=3,
+    local_epochs=1,
+    samples_per_peer=32,
+    batch_size=32,
+    lr=0.05,
+    server_lr=1.0,
+    compute_dtype="float32",
+    byzantine_f=2,
+    brb_enabled=True,
+)
+
+
+def _stripped(records):
+    return [
+        {k: v for k, v in rec.to_dict().items() if k != "duration_s"}
+        for rec in records
+    ]
+
+
+@requires_spmd
+def test_one_d2h_transfer_per_round():
+    telemetry.reset()
+    exp = Experiment(DRIVER_CFG)
+    exp.run()
+    assert telemetry.counter("driver.d2h_transfers").value == DRIVER_CFG.rounds
+
+
+@requires_spmd
+def test_no_recompile_across_trainer_sets_and_vacancies():
+    exp = Experiment(DRIVER_CFG)
+    exp.run_round(np.array([1, 3, 6]))
+    exp.run_round(np.array([0, 2, -1]))  # shrunken round, vacancy padding
+    exp.run_round(np.array([4, 5, 7]))
+    for fn in (exp.train_fn, exp.agg_fn, exp._digest_pack[0]):
+        assert fn.__wrapped__._cache_size() == 1
+
+
+@requires_spmd
+def test_pipelined_records_bit_identical():
+    recs_sync = Experiment(DRIVER_CFG, pipeline=False).run()
+    recs_pipe = Experiment(DRIVER_CFG, pipeline=True).run()
+    assert _stripped(recs_pipe) == _stripped(recs_sync)
+
+
+@requires_spmd
+def test_pipelined_records_bit_identical_under_chaos():
+    cfg = dataclasses.replace(DRIVER_CFG, rounds=4)
+    recs_sync = Experiment(
+        cfg, pipeline=False, fault_plan="crash_drop_partition"
+    ).run()
+    recs_pipe = Experiment(
+        cfg, pipeline=True, fault_plan="crash_drop_partition"
+    ).run()
+    assert _stripped(recs_pipe) == _stripped(recs_sync)
+    assert any(r.fault_events for r in recs_pipe)  # the plan actually fired
+
+
+@requires_spmd
+def test_pipelined_matches_per_message_framing():
+    """Framing changes the message ledger, not the verdicts: records agree
+    on everything except the control_messages/control_bytes accounting."""
+    recs_batched = Experiment(DRIVER_CFG, pipeline=True).run()
+    recs_v1 = Experiment(
+        dataclasses.replace(DRIVER_CFG, control_batching=False), pipeline=False
+    ).run()
+    drop = ("duration_s", "control_messages", "control_bytes")
+    a = [{k: v for k, v in r.to_dict().items() if k not in drop} for r in recs_batched]
+    b = [{k: v for k, v in r.to_dict().items() if k not in drop} for r in recs_v1]
+    assert a == b
+    # And the batched ledger is strictly cheaper.
+    assert sum(r.control_messages for r in recs_batched) < sum(
+        r.control_messages for r in recs_v1
+    )
