@@ -28,6 +28,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import math
+import struct
 import time
 from typing import Optional
 
@@ -35,6 +36,11 @@ from p2pdl_tpu.protocol import crypto
 from p2pdl_tpu.utils import telemetry
 
 SEND, ECHO, READY = "send", "echo", "ready"
+
+# Every digest on the wire is a SHA-256 output; anything else is malformed.
+DIGEST_LEN = 32
+
+_BATCH_KIND_CODE = {ECHO: 1, READY: 2}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,16 +108,28 @@ class BRBBatch:
     signature: Optional[bytes] = None  # over signing_bytes()
 
     def signing_bytes(self) -> bytes:
+        # Injective, fixed-width encoding: every field has a known width and
+        # the item count is part of the header, so no two distinct vote
+        # lists serialize to the same signed bytes. (A delimiter-joined
+        # layout is NOT injective once variable-length digests sit next to
+        # integer fields: adjacent votes can re-frame across the delimiter
+        # and an honest signature would verify for a different vote list.)
+        code = _BATCH_KIND_CODE.get(self.kind)
+        if code is None:
+            raise ValueError(f"unsignable batch kind: {self.kind!r}")
         parts = [
-            b"batch",
-            self.kind.encode(),
-            str(self.from_id).encode(),
-            str(self.seq).encode(),
+            struct.pack(
+                ">4sBqqI", b"BRB2", code, self.from_id, self.seq, len(self.items)
+            )
         ]
         for sender, digest in self.items:
-            parts.append(str(sender).encode())
+            if len(digest) != DIGEST_LEN:
+                raise ValueError(
+                    f"batch digest must be {DIGEST_LEN} bytes, got {len(digest)}"
+                )
+            parts.append(struct.pack(">q", sender))
             parts.append(digest)
-        return b"|".join(parts)
+        return b"".join(parts)
 
 
 # A batch larger than this is hostile (it could mint that many instances
@@ -359,6 +377,19 @@ class Broadcaster:
         one-vote-per-peer caps, exactly as in the per-message framing."""
         if batch.kind not in (ECHO, READY) or len(batch.items) > MAX_BATCH_ITEMS:
             return []
+        # Shape-validate every item BEFORE any crypto: a vote may only name
+        # a registered peer as its broadcast sender and must carry exactly
+        # one SHA-256 digest. Without this, one validly-signed frame could
+        # mint instances for arbitrary sender ids and store arbitrarily
+        # long byte strings as vote keys — a memory amplification the v1
+        # per-message path never allowed. (Registered-key membership, not
+        # ``cfg.n``, is the sender universe: live-membership reconfigure
+        # shrinks ``cfg.n`` to the surviving committee while any registered
+        # peer may still originate a broadcast.)
+        for sender, digest in batch.items:
+            if len(digest) != DIGEST_LEN or not self.key_server.has_key(int(sender)):
+                telemetry.counter("brb.batch_rejected", reason="malformed_item").inc()
+                return []
         if not batch_ok(self.key_server, batch):
             telemetry.counter("brb.signature_failures", kind="batch").inc()
             return []

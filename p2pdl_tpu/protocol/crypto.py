@@ -220,6 +220,12 @@ class KeyServer:
             self._cache[peer_id] = key
         return key
 
+    def has_key(self, peer_id: int) -> bool:
+        """True iff ``peer_id`` is a registered peer — the membership test
+        protocol validators use to bound the sender universe."""
+        with self._lock:
+            return peer_id in self._keys
+
     def verify(self, peer_id: int, signature: bytes, data: bytes) -> bool:
         """Verify ``data`` against peer ``peer_id``'s registered key
         (reference ``utils/crypto.py:64-101`` folds this lookup into

@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
@@ -55,6 +56,24 @@ from p2pdl_tpu.protocol.transport import (
 from p2pdl_tpu.utils import telemetry
 from p2pdl_tpu.utils.metrics import MetricsLogger
 from p2pdl_tpu.utils.profiling import Profiler
+
+# One process-wide pool for per-row digest hashing: the jobs are stateless
+# (pure SHA-256 over a host buffer), so Experiments share it rather than
+# each leaking a never-shut-down executor for the life of the process.
+_DIGEST_POOL: Optional[ThreadPoolExecutor] = None
+_DIGEST_POOL_LOCK = threading.Lock()
+
+
+def _digest_pool() -> ThreadPoolExecutor:
+    global _DIGEST_POOL
+    if _DIGEST_POOL is None:
+        with _DIGEST_POOL_LOCK:
+            if _DIGEST_POOL is None:
+                _DIGEST_POOL = ThreadPoolExecutor(
+                    max_workers=min(8, os.cpu_count() or 1),
+                    thread_name_prefix="p2pdl-digest",
+                )
+    return _DIGEST_POOL
 
 
 @dataclasses.dataclass
@@ -363,9 +382,8 @@ class Experiment:
         self.pipeline = bool(pipeline)
         self._pending_round: Optional[dict] = None
         # Single-transfer digesting state (lazy: built from the first
-        # round's delta tree).
+        # round's delta tree; row hashing runs on the shared module pool).
         self._digest_pack = None
-        self._digest_pool: Optional[ThreadPoolExecutor] = None
         # Chaos plane: a FaultPlan (object, scenario name, inline JSON, or
         # JSON file path) drives deterministic fault injection; the failure
         # detector always exists (empty suspicion set without faults) so
@@ -582,12 +600,9 @@ class Experiment:
         packed = pack_fn(delta, jnp.asarray(padded_host, jnp.int32))
         buf = np.asarray(jax.device_get(packed))  # the round's one D2H
         telemetry.counter("driver.d2h_transfers").inc()
-        if self._digest_pool is None:
-            self._digest_pool = ThreadPoolExecutor(
-                max_workers=min(8, os.cpu_count() or 1)
-            )
+        pool = _digest_pool()
         futures = {
-            int(t): self._digest_pool.submit(hash_row, buf[i])
+            int(t): pool.submit(hash_row, buf[i])
             for i, t in enumerate(padded_host)
             if t >= 0
         }

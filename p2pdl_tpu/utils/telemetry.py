@@ -234,11 +234,16 @@ class MetricsRegistry:
     ) -> None:
         self.enabled = enabled
         if max_series_per_metric is None:
-            max_series_per_metric = int(
-                os.environ.get(
-                    "P2PDL_TELEMETRY_MAX_SERIES", DEFAULT_MAX_SERIES_PER_METRIC
+            # A malformed override must not take down registry construction
+            # (the module-level default registry builds at import time).
+            try:
+                max_series_per_metric = int(
+                    os.environ.get(
+                        "P2PDL_TELEMETRY_MAX_SERIES", DEFAULT_MAX_SERIES_PER_METRIC
+                    )
                 )
-            )
+            except ValueError:
+                max_series_per_metric = DEFAULT_MAX_SERIES_PER_METRIC
         self.max_series_per_metric = max_series_per_metric
         self._lock = threading.Lock()
         self._counters: dict[str, Counter] = {}

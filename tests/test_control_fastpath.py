@@ -237,6 +237,54 @@ def test_forged_batch_signature_rejected():
     assert inst is None or not inst.echoes  # no vote landed
 
 
+def test_reframed_batch_signature_does_not_transfer():
+    """Wire-v2 batch signing is injective (fixed-width fields + item count
+    in the header): an honest signature over votes [(4, d4), (5, d5)] must
+    not verify for any re-framed vote list. A delimiter-joined encoding
+    would let [(4, d4 + b'|5|' + d5)] share the same signed bytes, letting
+    an attacker burn peer 4's one-vote slot on a junk digest."""
+    ks, bcs = _small_net(n=6, f=1)
+    d4, d5 = b"\x04" * 32, b"\x05" * 32
+    honest = bcs[1].make_batch(ECHO, 0, [(4, d4), (5, d5)])
+    merged = BRBBatch(
+        kind=ECHO,
+        from_id=1,
+        seq=0,
+        items=((4, d4 + b"|5|" + d5),),
+        signature=honest.signature,
+    )
+    assert bcs[3].handle_batch(merged) == []
+    inst = bcs[3].instances.get((4, 0))
+    assert inst is None or 1 not in inst._echo_voted
+
+
+def test_batch_with_non_sha256_digest_rejected():
+    _, bcs = _small_net()
+    # An honest signer cannot even express a malformed digest...
+    with pytest.raises(ValueError, match="32 bytes"):
+        bcs[1].make_batch(ECHO, 0, [(0, b"short")])
+    # ...and a hand-built frame is dropped before any instance is minted
+    # (and before any signature work).
+    bad = BRBBatch(
+        kind=ECHO,
+        from_id=1,
+        seq=0,
+        items=((0, b"\x01" * 16),),
+        signature=b"\x00" * 64,
+    )
+    assert bcs[3].handle_batch(bad) == []
+    assert (0, 0) not in bcs[3].instances
+
+
+def test_batch_vote_for_unregistered_sender_rejected():
+    """A validly-signed batch naming a sender with no registered key must
+    not mint BRBInstances (memory-amplification guard)."""
+    _, bcs = _small_net()
+    batch = bcs[1].make_batch(ECHO, 0, [(99, b"\x01" * 32)])
+    assert bcs[3].handle_batch(batch) == []
+    assert not any(sender == 99 for sender, _ in bcs[3].instances)
+
+
 def test_unsigned_batch_rejected():
     _, bcs = _small_net()
     naked = BRBBatch(kind=ECHO, from_id=1, seq=0, items=((0, b"\x01" * 32),))
@@ -315,6 +363,20 @@ def test_series_cap_reset_clears_counts():
     reg.reset()
     reg.counter("m", peer=1).inc()  # budget restored after reset
     assert reg._counters["m{peer=1}"].value == 1
+
+
+def test_malformed_max_series_env_falls_back(monkeypatch):
+    monkeypatch.setenv("P2PDL_TELEMETRY_MAX_SERIES", "not-a-number")
+    reg = MetricsRegistry()
+    assert reg.max_series_per_metric == telemetry.DEFAULT_MAX_SERIES_PER_METRIC
+
+
+def test_digest_pool_is_process_shared():
+    """Row hashing uses one module-level executor, not a leaked
+    per-Experiment pool."""
+    from p2pdl_tpu.runtime import driver as driver_mod
+
+    assert driver_mod._digest_pool() is driver_mod._digest_pool()
 
 
 # ---------------------------------------------------------------------------
