@@ -1,0 +1,24 @@
+"""p2plint: project-native static invariant checks (pure stdlib).
+
+Public surface: the engine (:func:`run_lint`, :func:`lint_source`,
+:func:`cli_lint`) plus the four rule families registered on import —
+determinism, host-sync, lock discipline, and wire conformance. See
+``engine.py`` for the suppression and baseline model.
+"""
+
+from p2pdl_tpu.analysis.engine import (  # noqa: F401
+    DEFAULT_BASELINE_PATH,
+    Finding,
+    LintResult,
+    ModuleInfo,
+    Rule,
+    all_rules,
+    cli_lint,
+    lint_source,
+    lint_tree,
+    load_baseline,
+    render_json,
+    render_text,
+    run_lint,
+    write_baseline_file,
+)

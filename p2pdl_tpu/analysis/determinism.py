@@ -1,0 +1,205 @@
+"""Determinism rules: replay-critical modules must be wall-clock-,
+entropy-, and set-order-free.
+
+The chaos plane's acceptance story is bit-identical replay of a
+``RoundRecord`` stream; any hidden nondeterminism in ``protocol/``,
+``parallel/``, or the driver breaks it silently. Three rules:
+
+- ``determinism-wallclock``: ``time.time()`` / ``time.time_ns()`` /
+  ``datetime.now()``-family reads. ``time.perf_counter`` / ``monotonic``
+  are allowed by design — they feed ``duration_s`` telemetry stamps,
+  which are explicitly outside the replayed state.
+- ``determinism-entropy``: ``os.urandom``, ``secrets.*``, ``uuid.uuid1/4``,
+  module-level ``random.*`` / legacy ``numpy.random.*`` draws, and
+  *unseeded* ``numpy.random.default_rng()`` / ``random.Random()``
+  constructions. Seeded constructions are the sanctioned pattern.
+- ``determinism-set-order``: iterating a ``set`` (``for``, comprehensions,
+  ``list()``/``tuple()``/``enumerate()``/``iter()``/``.join()`` over a set
+  display, set comprehension, or ``set()``/``frozenset()`` call). Python
+  sets hash-order-randomize ``str``/``bytes`` keys across interpreter
+  runs, so any set-ordered traversal is replay-hostile; ``sorted(set(...))``
+  is the sanctioned spelling and is not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from p2pdl_tpu.analysis.engine import Finding, ModuleInfo, Rule, register
+
+REPLAY_SCOPE = ("protocol/", "parallel/", "runtime/driver.py")
+
+_WALLCLOCK = {"time.time", "time.time_ns"}
+_DT_METHODS = {"now", "utcnow", "today"}
+_ENTROPY_EXACT = {"os.urandom", "uuid.uuid4", "uuid.uuid1"}
+# Module-level draw functions on `random` / `numpy.random` (shared global RNG).
+_RANDOM_MODULE_FNS = {
+    "random",
+    "randint",
+    "randrange",
+    "uniform",
+    "normalvariate",
+    "gauss",
+    "choice",
+    "choices",
+    "shuffle",
+    "sample",
+    "betavariate",
+    "expovariate",
+    "getrandbits",
+    "random_sample",
+    "rand",
+    "randn",
+    "permutation",
+    "bytes",
+    "standard_normal",
+}
+
+
+class WallclockRule(Rule):
+    name = "determinism-wallclock"
+    description = "wall-clock reads in replay-critical code"
+    scope = REPLAY_SCOPE
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.dotted(node.func)
+            if dotted in _WALLCLOCK:
+                yield mod.finding(
+                    self.name,
+                    node,
+                    f"wall-clock read `{dotted}()` in replay-critical code; "
+                    "stamp durations via time.perf_counter outside the "
+                    "recorded state",
+                )
+            elif dotted is not None:
+                parts = dotted.split(".")
+                if parts[-1] in _DT_METHODS and any(
+                    "datetime" in p or p == "date" for p in parts[:-1]
+                ):
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        f"wall-clock read `{dotted}()` in replay-critical "
+                        "code; replayed state must not embed the current "
+                        "date/time",
+                    )
+
+
+class EntropyRule(Rule):
+    name = "determinism-entropy"
+    description = "unseeded randomness in replay-critical code"
+    scope = REPLAY_SCOPE
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.dotted(node.func)
+            if dotted is None:
+                continue
+            parts = dotted.split(".")
+            if dotted in _ENTROPY_EXACT or parts[0] == "secrets":
+                yield mod.finding(
+                    self.name,
+                    node,
+                    f"OS entropy `{dotted}()` in replay-critical code; "
+                    "derive randomness from the recorded seed instead",
+                )
+            elif (
+                len(parts) == 2
+                and parts[0] == "random"
+                and parts[1] in _RANDOM_MODULE_FNS
+            ):
+                yield mod.finding(
+                    self.name,
+                    node,
+                    f"global-RNG draw `{dotted}()` in replay-critical code; "
+                    "use a seeded random.Random / numpy Generator",
+                )
+            elif (
+                len(parts) == 3
+                and parts[0] == "numpy"
+                and parts[1] == "random"
+                and parts[2] in _RANDOM_MODULE_FNS
+            ):
+                yield mod.finding(
+                    self.name,
+                    node,
+                    f"legacy global-RNG draw `{dotted}()` in replay-critical "
+                    "code; use numpy.random.default_rng(seed)",
+                )
+            elif dotted in ("numpy.random.default_rng", "random.Random"):
+                if not node.args and not node.keywords:
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        f"unseeded `{dotted}()` in replay-critical code; "
+                        "pass an explicit seed",
+                    )
+
+
+def _is_setlike(mod: ModuleInfo, node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return mod.dotted(node.func) in ("set", "frozenset")
+    return False
+
+
+class SetOrderRule(Rule):
+    name = "determinism-set-order"
+    description = "order-dependent traversal of an unordered set"
+    scope = REPLAY_SCOPE
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        advice = "; wrap in sorted(...) for a replay-stable order"
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                if _is_setlike(mod, node.iter):
+                    yield mod.finding(
+                        self.name,
+                        node.iter,
+                        "`for` loop iterates a set in hash order" + advice,
+                    )
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+            ):
+                for gen in node.generators:
+                    if _is_setlike(mod, gen.iter):
+                        yield mod.finding(
+                            self.name,
+                            gen.iter,
+                            "comprehension iterates a set in hash order" + advice,
+                        )
+            elif isinstance(node, ast.Call):
+                dotted = mod.dotted(node.func)
+                if (
+                    dotted in ("list", "tuple", "enumerate", "iter")
+                    and node.args
+                    and _is_setlike(mod, node.args[0])
+                ):
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        f"`{dotted}()` materializes a set in hash order" + advice,
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"
+                    and node.args
+                    and _is_setlike(mod, node.args[0])
+                ):
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        "`.join()` consumes a set in hash order" + advice,
+                    )
+
+
+register(WallclockRule())
+register(EntropyRule())
+register(SetOrderRule())

@@ -1,0 +1,486 @@
+"""p2plint engine: AST rule runner, suppressions, baseline, reporters.
+
+A project-native static-analysis pass: the protocol invariants the paper's
+trust plane rests on (injective wire encodings, bit-identical replay, one
+device->host transfer per round, lock discipline around shared state) are
+properties of the *source tree*, not of any one test run — so they are
+checked as such. The engine is deliberately small and stdlib-only (``ast``
+plus ``struct`` for format validation): it must run anywhere the repo
+checks out, with no backend and no third-party linter framework.
+
+Moving parts:
+
+- **Rules** (:class:`Rule`) are registered checker objects; each declares a
+  stable ``name`` (the suppression/baseline key) and an optional
+  package-relative ``scope``. The four rule families live in sibling
+  modules (``determinism``, ``hostsync``, ``locks``, ``wire``).
+- **Suppressions**: ``# p2plint: disable=rule-a,rule-b -- reason`` on the
+  offending line (or on a standalone comment line directly above it)
+  silences those rules for that line; ``# p2plint: disable-file=rule``
+  anywhere in a file silences the rule file-wide. ``all`` matches every
+  rule. The ``-- reason`` tail is for the human reader and is required by
+  convention (the gate test has no way to check intent, reviewers do).
+- **Baseline**: pre-existing, justified findings live in a committed JSON
+  file keyed by ``(rule, path, context, message)`` — deliberately *not* by
+  line number, so unrelated edits above a finding do not invalidate the
+  baseline. Every entry carries a ``reason`` string. Regenerate with
+  ``python -m p2pdl_tpu.cli lint --write-baseline`` (existing reasons are
+  preserved; new entries get a TODO placeholder that a human must edit).
+- **Reporters**: human text (``path:line:col: rule: message``) and a JSON
+  document (``--json``) for tooling.
+
+The tier-1 gate (``tests/test_lint_gate.py``) runs :func:`run_lint` over
+the package tree and fails on any finding that is neither suppressed nor
+baselined — so the invariants ride the existing verify command with no CI
+infrastructure.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Any, Iterable, Optional
+
+DIRECTIVE = "p2plint:"
+ALL_RULES_TOKEN = "all"
+
+#: Default lint root: the installed package tree.
+PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+#: Default committed baseline location.
+DEFAULT_BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location.
+
+    ``context`` is the enclosing qualname (``Class.method`` or
+    ``<module>``); the baseline fingerprint is ``(rule, path, context,
+    message)`` — line/col are for the human report only, so findings
+    survive unrelated line-number drift.
+    """
+
+    rule: str
+    path: str  # package-relative posix path
+    line: int
+    col: int
+    message: str
+    context: str
+
+    def fingerprint(self) -> tuple[str, str, str, str]:
+        return (self.rule, self.path, self.context, self.message)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class Suppressions:
+    """Per-file suppression index parsed from ``# p2plint:`` comments."""
+
+    def __init__(self, lines: list[str]) -> None:
+        self.line_rules: dict[int, set[str]] = {}
+        self.file_rules: set[str] = set()
+        for i, raw in enumerate(lines, start=1):
+            hash_pos = raw.find("#")
+            if hash_pos < 0:
+                continue
+            comment = raw[hash_pos:]
+            d = comment.find(DIRECTIVE)
+            if d < 0:
+                continue
+            body = comment[d + len(DIRECTIVE) :].strip()
+            # Strip the human-readable reason tail.
+            body = body.split("--", 1)[0].strip()
+            rules: Optional[set[str]] = None
+            target_file = False
+            if body.startswith("disable-file="):
+                rules = {r.strip() for r in body[len("disable-file=") :].split(",")}
+                target_file = True
+            elif body.startswith("disable="):
+                rules = {r.strip() for r in body[len("disable=") :].split(",")}
+            if not rules:
+                continue
+            rules = {r for r in rules if r}
+            if target_file:
+                self.file_rules |= rules
+            else:
+                self.line_rules.setdefault(i, set()).update(rules)
+                # A standalone comment line suppresses the line below it.
+                if raw[:hash_pos].strip() == "":
+                    self.line_rules.setdefault(i + 1, set()).update(rules)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        for pool in (self.file_rules, self.line_rules.get(line, ())):
+            if rule in pool or ALL_RULES_TOKEN in pool:
+                return True
+        return False
+
+
+def _build_contexts(tree: ast.AST) -> dict[ast.AST, str]:
+    """Map every node to its enclosing qualname (``Class.method`` etc.)."""
+    contexts: dict[ast.AST, str] = {tree: "<module>"}
+
+    def walk(node: ast.AST, name: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_name = name
+            if isinstance(
+                child, (ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                child_name = f"{name}.{child.name}" if name else child.name
+            contexts[child] = child_name or "<module>"
+            walk(child, child_name)
+
+    walk(tree, "")
+    return contexts
+
+
+def _build_aliases(tree: ast.AST) -> dict[str, str]:
+    """Import alias map: local name -> canonical dotted origin.
+
+    ``import numpy as np`` -> ``{"np": "numpy"}``; ``from os import urandom``
+    -> ``{"urandom": "os.urandom"}``. Rules match canonical names, so
+    renamed imports cannot dodge a checker.
+    """
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for n in node.names:
+                if n.asname:
+                    aliases[n.asname] = n.name
+                else:
+                    first = n.name.split(".")[0]
+                    aliases.setdefault(first, first)
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for n in node.names:
+                if n.name == "*":
+                    continue
+                aliases[n.asname or n.name] = f"{node.module}.{n.name}"
+    return aliases
+
+
+class ModuleInfo:
+    """One parsed source file plus the indexes the rules share."""
+
+    def __init__(self, source: str, relpath: str, path: str = "") -> None:
+        self.source = source
+        self.relpath = relpath.replace(os.sep, "/")
+        self.path = path or relpath
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self.contexts = _build_contexts(self.tree)
+        self.aliases = _build_aliases(self.tree)
+        self.suppressions = Suppressions(self.lines)
+
+    @property
+    def norm_relpath(self) -> str:
+        """Package-relative path: a leading ``p2pdl_tpu/`` is stripped so
+        rule scopes match both an in-repo root and a fixture tree."""
+        p = self.relpath
+        if p.startswith("p2pdl_tpu/"):
+            p = p[len("p2pdl_tpu/") :]
+        return p
+
+    def context_of(self, node: ast.AST) -> str:
+        return self.contexts.get(node, "<module>")
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of a Name/Attribute chain, imports
+        resolved; None for anything not a plain chain."""
+        parts: list[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        parts.append(cur.id)
+        parts.reverse()
+        parts[0] = self.aliases.get(parts[0], parts[0])
+        return ".".join(parts)
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.relpath,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            context=self.context_of(node),
+        )
+
+
+class Rule:
+    """Base checker: a stable ``name``, an optional package-relative
+    ``scope`` (tuple of path prefixes; ``None`` = every file), and a
+    ``check(mod)`` returning findings. Subclasses are registered once as
+    instances via :func:`register`."""
+
+    name: str = ""
+    description: str = ""
+    scope: Optional[tuple[str, ...]] = None
+
+    def applies(self, mod: ModuleInfo) -> bool:
+        if self.scope is None:
+            return True
+        p = mod.norm_relpath
+        return any(
+            p == s or (s.endswith("/") and p.startswith(s)) for s in self.scope
+        )
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+_RULES: dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    if not rule.name:
+        raise ValueError("rule needs a stable name")
+    if rule.name in _RULES:
+        raise ValueError(f"duplicate rule name {rule.name!r}")
+    _RULES[rule.name] = rule
+    return rule
+
+
+def all_rules() -> list[Rule]:
+    """Every registered rule, rule modules imported on first use."""
+    if not _RULES:
+        from p2pdl_tpu.analysis import determinism, hostsync, locks, wire  # noqa: F401
+
+    return list(_RULES.values())
+
+
+def lint_module(mod: ModuleInfo, rules: Optional[list[Rule]] = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for rule in rules if rules is not None else all_rules():
+        if not rule.applies(mod):
+            continue
+        for f in rule.check(mod):
+            if not mod.suppressions.is_suppressed(f.rule, f.line):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_source(
+    source: str, relpath: str, rules: Optional[list[Rule]] = None
+) -> list[Finding]:
+    """Lint one in-memory source blob (the test-fixture entry point)."""
+    try:
+        mod = ModuleInfo(source, relpath)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="parse-error",
+                path=relpath.replace(os.sep, "/"),
+                line=e.lineno or 0,
+                col=e.offset or 0,
+                message=f"file does not parse: {e.msg}",
+                context="<module>",
+            )
+        ]
+    return lint_module(mod, rules)
+
+
+def iter_python_files(root: str) -> Iterable[tuple[str, str]]:
+    """Yield ``(abspath, relpath)`` for every ``.py`` under ``root``."""
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in ("__pycache__", ".git")
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                full = os.path.join(dirpath, fn)
+                yield full, os.path.relpath(full, root).replace(os.sep, "/")
+
+
+def lint_tree(
+    root: Optional[str] = None, rules: Optional[list[Rule]] = None
+) -> tuple[list[Finding], int]:
+    """Lint every Python file under ``root`` (default: the package tree);
+    returns ``(findings, files_scanned)``."""
+    root = root or PACKAGE_ROOT
+    findings: list[Finding] = []
+    n_files = 0
+    for full, rel in iter_python_files(root):
+        n_files += 1
+        with open(full, encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(lint_source(source, rel, rules))
+    return findings, n_files
+
+
+# ---- Baseline ---------------------------------------------------------------
+
+
+def load_baseline(path: Optional[str] = None) -> list[dict[str, Any]]:
+    """Baseline entries; a missing file is an empty baseline, a malformed
+    one is an error (a silently-ignored baseline would un-gate the tree)."""
+    path = path or DEFAULT_BASELINE_PATH
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    entries = doc.get("entries") if isinstance(doc, dict) else None
+    if not isinstance(entries, list):
+        raise ValueError(f"{path}: expected {{'entries': [...]}} baseline document")
+    return entries
+
+
+def _entry_fp(entry: dict[str, Any]) -> tuple[str, str, str, str]:
+    return (
+        str(entry.get("rule", "")),
+        str(entry.get("path", "")),
+        str(entry.get("context", "")),
+        str(entry.get("message", "")),
+    )
+
+
+def apply_baseline(
+    findings: list[Finding], entries: list[dict[str, Any]]
+) -> tuple[list[Finding], list[Finding], list[dict[str, Any]]]:
+    """Split findings into ``(new, baselined)`` and return the baseline
+    entries that matched nothing (``stale``) — drift in either direction is
+    visible."""
+    known = {_entry_fp(e) for e in entries}
+    matched: set[tuple[str, str, str, str]] = set()
+    new: list[Finding] = []
+    baselined: list[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if fp in known:
+            matched.add(fp)
+            baselined.append(f)
+        else:
+            new.append(f)
+    stale = [e for e in entries if _entry_fp(e) not in matched]
+    return new, baselined, stale
+
+
+TODO_REASON = "TODO: justify this finding or fix the code"
+
+
+def write_baseline_file(
+    path: str, findings: list[Finding], existing: Optional[list[dict[str, Any]]] = None
+) -> int:
+    """Write a baseline covering every current finding. Reasons from
+    ``existing`` entries are preserved by fingerprint; genuinely new
+    entries get :data:`TODO_REASON` (a human must replace it — the gate
+    test refuses TODO reasons). Returns the number of entries written."""
+    reasons = {_entry_fp(e): e.get("reason", TODO_REASON) for e in existing or []}
+    entries = []
+    seen: set[tuple[str, str, str, str]] = set()
+    for f in sorted(findings, key=lambda f: (f.path, f.rule, f.context, f.message)):
+        fp = f.fingerprint()
+        if fp in seen:
+            continue  # one entry suppresses every identical-fingerprint finding
+        seen.add(fp)
+        entries.append(
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "context": f.context,
+                "message": f.message,
+                "line": f.line,  # informational only; never matched on
+                "reason": reasons.get(fp, TODO_REASON),
+            }
+        )
+    doc = {
+        "comment": (
+            "p2plint baseline: pre-existing, justified findings. Matched by "
+            "(rule, path, context, message) — 'line' is informational. Every "
+            "entry needs a real 'reason'; regenerate with "
+            "`python -m p2pdl_tpu.cli lint --write-baseline` (reasons are "
+            "preserved) and justify anything new."
+        ),
+        "entries": entries,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return len(entries)
+
+
+# ---- Orchestration + reporters ---------------------------------------------
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]  # everything, pre-baseline
+    new: list[Finding]
+    baselined: list[Finding]
+    stale_entries: list[dict[str, Any]]
+    files_scanned: int
+
+
+def run_lint(
+    root: Optional[str] = None,
+    baseline_path: Optional[str] = None,
+    rules: Optional[list[Rule]] = None,
+) -> LintResult:
+    findings, n_files = lint_tree(root, rules)
+    entries = load_baseline(baseline_path)
+    new, baselined, stale = apply_baseline(findings, entries)
+    return LintResult(
+        findings=findings,
+        new=new,
+        baselined=baselined,
+        stale_entries=stale,
+        files_scanned=n_files,
+    )
+
+
+def render_text(result: LintResult) -> str:
+    out: list[str] = []
+    for f in result.new:
+        out.append(f"{f.path}:{f.line}:{f.col}: {f.rule}: {f.message} [{f.context}]")
+    for e in result.stale_entries:
+        out.append(
+            f"stale baseline entry: {e.get('rule')} @ {e.get('path')} "
+            f"[{e.get('context')}]: {e.get('message')}"
+        )
+    out.append(
+        f"p2plint: {result.files_scanned} files, "
+        f"{len(result.new)} new finding(s), "
+        f"{len(result.baselined)} baselined, "
+        f"{len(result.stale_entries)} stale baseline entr(y/ies)"
+    )
+    return "\n".join(out)
+
+
+def render_json(result: LintResult) -> dict[str, Any]:
+    return {
+        "files_scanned": result.files_scanned,
+        "new_findings": [f.to_dict() for f in result.new],
+        "baselined_count": len(result.baselined),
+        "stale_baseline_entries": result.stale_entries,
+        "exit_code": 1 if result.new else 0,
+    }
+
+
+def cli_lint(
+    root: Optional[str] = None,
+    baseline_path: Optional[str] = None,
+    json_out: bool = False,
+    write_baseline: bool = False,
+) -> int:
+    """The ``p2pdl_tpu.cli lint`` implementation. Exit 0 iff the tree is
+    clean modulo the baseline (stale entries print as warnings but do not
+    fail the CLI — the gate test is the strict consumer)."""
+    baseline_path = baseline_path or DEFAULT_BASELINE_PATH
+    result = run_lint(root, baseline_path)
+    if write_baseline:
+        existing = load_baseline(baseline_path)
+        n = write_baseline_file(baseline_path, result.findings, existing)
+        print(f"p2plint: wrote {n} baseline entr(y/ies) to {baseline_path}")
+        return 0
+    if json_out:
+        print(json.dumps(render_json(result), indent=2))
+    else:
+        print(render_text(result))
+    return 1 if result.new else 0

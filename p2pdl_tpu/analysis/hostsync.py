@@ -1,0 +1,91 @@
+"""Host-sync rule: protect the one-device->host-transfer-per-round path.
+
+PR 4 collapsed the per-round readback to a single ``jax.device_get`` of a
+packed digest buffer (``driver.d2h_transfers`` counts it). Any new
+``.item()`` / ``np.asarray`` / ``float()``-on-array sneaking into
+``runtime/driver.py`` or ``parallel/round.py`` silently reintroduces a
+blocking sync per call site. This rule flags:
+
+- explicit transfers: ``jax.device_get(...)``, ``numpy.asarray(...)``,
+  ``numpy.array(...)`` (H2D-side ``jax.numpy.asarray`` is fine and not
+  flagged);
+- ``.item()`` calls with no arguments (the classic scalar sync);
+- ``float()`` / ``int()`` / ``bool()`` casts whose argument mentions a
+  device-suggesting expression: a name ending in ``_dev``, the eval-result
+  dict ``ev``, or the on-device ``self.state`` tree.
+
+Sanctioned sites (the audited single transfer, deferred block-boundary
+readbacks) carry inline ``# p2plint: disable=hostsync-transfer`` comments
+with reasons, or live in the committed baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from p2pdl_tpu.analysis.engine import Finding, ModuleInfo, Rule, register
+
+_TRANSFER_FNS = {"jax.device_get", "numpy.asarray", "numpy.array"}
+_CAST_FNS = {"float", "int", "bool"}
+
+
+def _device_marker(mod: ModuleInfo, node: ast.AST) -> Optional[str]:
+    """A human-readable marker if ``node``'s subtree mentions a
+    device-suggesting expression, else None."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            if sub.id.endswith("_dev") or sub.id == "ev":
+                return sub.id
+        elif isinstance(sub, ast.Attribute):
+            if sub.attr.endswith("_dev"):
+                return sub.attr
+            dotted = mod.dotted(sub)
+            if dotted is not None and dotted.startswith("self.state"):
+                return "self.state"
+    return None
+
+
+class HostSyncRule(Rule):
+    name = "hostsync-transfer"
+    description = "implicit device->host transfer outside the audited path"
+    scope = ("runtime/driver.py", "parallel/round.py")
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.dotted(node.func)
+            if dotted in _TRANSFER_FNS:
+                yield mod.finding(
+                    self.name,
+                    node,
+                    f"device->host transfer `{dotted}(...)` outside the "
+                    "audited single-transfer path; batch it into the packed "
+                    "digest readback or justify it",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+                and not node.args
+                and not node.keywords
+            ):
+                yield mod.finding(
+                    self.name,
+                    node,
+                    "`.item()` forces a blocking device->host scalar sync; "
+                    "read scalars from the packed digest buffer instead",
+                )
+            elif dotted in _CAST_FNS and node.args:
+                marker = _device_marker(mod, node.args[0])
+                if marker is not None:
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        f"host scalar cast `{dotted}(...)` over "
+                        f"device-derived value `{marker}` forces a "
+                        "device->host sync",
+                    )
+
+
+register(HostSyncRule())

@@ -1,0 +1,237 @@
+"""Lock-discipline rule: shared mutable state crosses the lock boundary.
+
+The hub/transport/cluster/telemetry classes all follow one convention: a
+``self._lock = threading.Lock()`` in ``__init__`` and every post-init
+write to shared attributes under ``with self._lock:``. This rule does a
+per-class lexical dataflow over that convention and flags any attribute
+written *both* inside and outside the lock — the mixed case is the bug
+(an attribute consistently written without the lock is usually
+single-threaded by design and produces no finding; requiring both sides
+keeps the rule's false-positive rate near zero).
+
+Tracked writes: ``self.x = ...``, ``self.x += ...``, ``self.x[...] = ...``
+and in-place mutator calls (``self.x.append(...)``, ``.pop()``,
+``.update()`` ...). ``__init__`` is exempt (the object is not yet shared).
+The same analysis runs at module level for ``LOCK = threading.Lock()``
+globals guarding ``global X`` writes (the driver's digest-pool
+double-checked locking pattern).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from p2pdl_tpu.analysis.engine import Finding, ModuleInfo, Rule, register
+
+_LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+}
+_MUTATORS = {
+    "append",
+    "appendleft",
+    "add",
+    "discard",
+    "remove",
+    "clear",
+    "extend",
+    "insert",
+    "pop",
+    "popitem",
+    "popleft",
+    "update",
+    "setdefault",
+}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.x`` -> ``"x"``, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _WriteLog:
+    """Per-attribute write sites, split by lock-held state."""
+
+    def __init__(self) -> None:
+        self.inside: dict[str, list[ast.AST]] = {}
+        self.outside: dict[str, list[ast.AST]] = {}
+
+    def record(self, attr: str, node: ast.AST, locked: bool) -> None:
+        pool = self.inside if locked else self.outside
+        pool.setdefault(attr, []).append(node)
+
+
+def _writes_in_stmt(stmt: ast.stmt, attr_of, log: _WriteLog, locked: bool) -> None:
+    """Record every tracked write inside one simple statement (or the
+    header expressions of a compound one). ``attr_of`` maps an expression
+    to the tracked attribute name, or None."""
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                attr = attr_of(base)
+                if attr is not None:
+                    log.record(attr, t, locked)
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATORS:
+                attr = attr_of(node.func.value)
+                if attr is not None:
+                    log.record(attr, node, locked)
+
+
+def _is_lock_expr(expr: ast.AST, lock_attrs: set[str], lock_globals: set[str]) -> bool:
+    attr = _self_attr(expr)
+    if attr is not None and attr in lock_attrs:
+        return True
+    if isinstance(expr, ast.Name) and expr.id in lock_globals:
+        return True
+    return False
+
+
+def _scan_stmts(
+    stmts: list[ast.stmt],
+    attr_of,
+    log: _WriteLog,
+    locked: bool,
+    lock_attrs: set[str],
+    lock_globals: set[str],
+) -> None:
+    for st in stmts:
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            takes_lock = any(
+                _is_lock_expr(item.context_expr, lock_attrs, lock_globals)
+                for item in st.items
+            )
+            # Header expressions (the context managers) run unlocked.
+            for item in st.items:
+                _writes_in_stmt(
+                    ast.Expr(value=item.context_expr), attr_of, log, locked
+                )
+            _scan_stmts(
+                st.body, attr_of, log, locked or takes_lock, lock_attrs, lock_globals
+            )
+        elif isinstance(st, (ast.If, ast.While)):
+            _writes_in_stmt(ast.Expr(value=st.test), attr_of, log, locked)
+            _scan_stmts(st.body, attr_of, log, locked, lock_attrs, lock_globals)
+            _scan_stmts(st.orelse, attr_of, log, locked, lock_attrs, lock_globals)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            _writes_in_stmt(ast.Expr(value=st.iter), attr_of, log, locked)
+            _scan_stmts(st.body, attr_of, log, locked, lock_attrs, lock_globals)
+            _scan_stmts(st.orelse, attr_of, log, locked, lock_attrs, lock_globals)
+        elif isinstance(st, ast.Try):
+            _scan_stmts(st.body, attr_of, log, locked, lock_attrs, lock_globals)
+            for h in st.handlers:
+                _scan_stmts(h.body, attr_of, log, locked, lock_attrs, lock_globals)
+            _scan_stmts(st.orelse, attr_of, log, locked, lock_attrs, lock_globals)
+            _scan_stmts(st.finalbody, attr_of, log, locked, lock_attrs, lock_globals)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # A closure defined here may run later on any thread: treat its
+            # body as unlocked regardless of the enclosing with-block.
+            _scan_stmts(st.body, attr_of, log, False, lock_attrs, lock_globals)
+        else:
+            _writes_in_stmt(st, attr_of, log, locked)
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = "shared attribute written both with and without its lock"
+    scope = None  # everywhere
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        yield from self._check_classes(mod)
+        yield from self._check_module_globals(mod)
+
+    # -- classes with self._lock ------------------------------------------
+
+    def _check_classes(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            lock_attrs: set[str] = set()
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                    if mod.dotted(node.value.func) in _LOCK_FACTORIES:
+                        for t in node.targets:
+                            attr = _self_attr(t)
+                            if attr is not None:
+                                lock_attrs.add(attr)
+            if not lock_attrs:
+                continue
+
+            def attr_of(expr: ast.AST) -> Optional[str]:
+                attr = _self_attr(expr)
+                if attr is None or attr in lock_attrs:
+                    return None
+                return attr
+
+            log = _WriteLog()
+            for item in cls.body:
+                if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if item.name == "__init__":
+                    continue  # not yet shared across threads
+                _scan_stmts(item.body, attr_of, log, False, lock_attrs, set())
+            lock_name = sorted(lock_attrs)[0]
+            for attr in sorted(set(log.inside) & set(log.outside)):
+                first = min(log.outside[attr], key=lambda n: getattr(n, "lineno", 0))
+                yield mod.finding(
+                    self.name,
+                    first,
+                    f"attribute `self.{attr}` of `{cls.name}` is written both "
+                    f"with and without `self.{lock_name}` held",
+                )
+
+    # -- module-level LOCK = threading.Lock() globals ----------------------
+
+    def _check_module_globals(self, mod: ModuleInfo) -> Iterable[Finding]:
+        lock_globals: set[str] = set()
+        for st in mod.tree.body:
+            if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call):
+                if mod.dotted(st.value.func) in _LOCK_FACTORIES:
+                    for t in st.targets:
+                        if isinstance(t, ast.Name):
+                            lock_globals.add(t.id)
+        if not lock_globals:
+            return
+
+        log = _WriteLog()
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            declared: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    declared.update(node.names)
+            declared -= lock_globals
+            if not declared:
+                continue
+
+            def attr_of(expr: ast.AST) -> Optional[str]:
+                if isinstance(expr, ast.Name) and expr.id in declared:
+                    return expr.id
+                return None
+
+            _scan_stmts(fn.body, attr_of, log, False, set(), lock_globals)
+        lock_name = sorted(lock_globals)[0]
+        for name in sorted(set(log.inside) & set(log.outside)):
+            first = min(log.outside[name], key=lambda n: getattr(n, "lineno", 0))
+            yield mod.finding(
+                self.name,
+                first,
+                f"global `{name}` is written both with and without "
+                f"`{lock_name}` held",
+            )
+
+
+register(LockDisciplineRule())

@@ -1,0 +1,280 @@
+"""Wire-conformance rules: struct formats, signing injectivity, kind codes.
+
+PR 4's review found a real forgery: the v1 ``BRBBatch.signing_bytes``
+joined variable-width fields with ``b"|"``, so two different batches could
+produce one signed byte string (re-framing attack). The fix was fixed-width
+``struct.pack`` fields; these rules make that pattern — and basic wire
+hygiene — machine-checked:
+
+- ``wire-struct``: every ``struct.pack``/``unpack``/``Struct`` call with a
+  literal format string is validated (``calcsize``), ``pack`` argument
+  counts must match the format's consumed-value count, and ``unpack``
+  buffer lengths are checked when statically known (``f.read(4)``,
+  ``_read_exact(f, 4)``, a bytes literal, a constant slice).
+- ``wire-signing``: inside any function whose name contains ``signing``,
+  a ``.join`` with a non-empty literal delimiter is flagged (delimiter
+  joins of attacker-influenced fields are not injective), as is any
+  variable-width ``str(...).encode()`` field. ``b"".join`` of fixed-width
+  pieces — the sanctioned PR 4 pattern — is clean.
+- ``wire-kind-dup``: module/class-level dict literals whose name looks
+  like a kind/code registry must register each key and each code exactly
+  once, and the registry itself must be assigned only once.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import struct
+from typing import Iterable, Optional
+
+from p2pdl_tpu.analysis.engine import Finding, ModuleInfo, Rule, register
+
+_STRUCT_CALLS = {
+    "struct.pack",
+    "struct.pack_into",
+    "struct.unpack",
+    "struct.unpack_from",
+    "struct.Struct",
+    "struct.calcsize",
+}
+_FMT_TOKEN = re.compile(r"(\d*)([xcbB?hHiIlLqQnNefdspP])")
+
+
+def _fmt_arg_count(fmt: str) -> int:
+    """How many Python values a struct format consumes/produces.
+
+    ``s``/``p`` consume one value regardless of count; ``x`` consumes
+    none; every other code consumes ``count`` values.
+    """
+    body = fmt.strip()
+    if body and body[0] in "@=<>!":
+        body = body[1:]
+    n = 0
+    for count, code in _FMT_TOKEN.findall(body.replace(" ", "")):
+        k = int(count) if count else 1
+        if code == "x":
+            continue
+        if code in "sp":
+            n += 1
+        else:
+            n += k
+    return n
+
+
+def _static_buffer_len(mod: ModuleInfo, node: ast.AST) -> Optional[int]:
+    """Statically-known byte length of an unpack buffer argument, if any."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, (bytes, bytearray)):
+        return len(node.value)
+    if isinstance(node, ast.Call):
+        # f.read(4) / stream.read(N)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "read"
+            and len(node.args) == 1
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, int)
+        ):
+            return node.args[0].value
+        # _read_exact(f, 4) helpers
+        dotted = mod.dotted(node.func)
+        if dotted is not None and dotted.split(".")[-1] in (
+            "_read_exact",
+            "read_exact",
+        ):
+            for a in node.args:
+                if isinstance(a, ast.Constant) and isinstance(a.value, int):
+                    return a.value
+    if isinstance(node, ast.Subscript) and isinstance(node.slice, ast.Slice):
+        lo, hi = node.slice.lower, node.slice.upper
+        lo_v = 0 if lo is None else (lo.value if isinstance(lo, ast.Constant) else None)
+        hi_v = hi.value if isinstance(hi, ast.Constant) else None
+        if (
+            isinstance(lo_v, int)
+            and isinstance(hi_v, int)
+            and lo_v >= 0
+            and hi_v >= lo_v
+            and node.slice.step is None
+        ):
+            return hi_v - lo_v
+    return None
+
+
+class StructFormatRule(Rule):
+    name = "wire-struct"
+    description = "struct format / argument / buffer-length consistency"
+    scope = None  # everywhere
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.dotted(node.func)
+            if dotted not in _STRUCT_CALLS or not node.args:
+                continue
+            fmt_node = node.args[0]
+            if not (
+                isinstance(fmt_node, ast.Constant)
+                and isinstance(fmt_node.value, (str, bytes))
+            ):
+                continue  # dynamic formats are out of static reach
+            fmt = (
+                fmt_node.value.decode("ascii", "replace")
+                if isinstance(fmt_node.value, bytes)
+                else fmt_node.value
+            )
+            try:
+                size = struct.calcsize(fmt)
+            except struct.error as e:
+                yield mod.finding(
+                    self.name, node, f"invalid struct format {fmt!r}: {e}"
+                )
+                continue
+            if any(isinstance(a, ast.Starred) for a in node.args) or node.keywords:
+                continue  # splatted values: count unknowable
+            expected = _fmt_arg_count(fmt)
+            if dotted == "struct.pack":
+                got = len(node.args) - 1
+                if got != expected:
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        f"struct.pack format {fmt!r} consumes {expected} "
+                        f"value(s) but the call passes {got}",
+                    )
+            elif dotted == "struct.pack_into":
+                got = len(node.args) - 3  # fmt, buffer, offset, *values
+                if got >= 0 and got != expected:
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        f"struct.pack_into format {fmt!r} consumes {expected} "
+                        f"value(s) but the call passes {got}",
+                    )
+            elif dotted == "struct.unpack" and len(node.args) >= 2:
+                buf_len = _static_buffer_len(mod, node.args[1])
+                if buf_len is not None and buf_len != size:
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        f"struct.unpack format {fmt!r} needs exactly {size} "
+                        f"byte(s) but the buffer provides {buf_len}",
+                    )
+
+
+class SigningBytesRule(Rule):
+    name = "wire-signing"
+    description = "signing-bytes builders must use fixed-width fields"
+    scope = None  # everywhere
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if "signing" not in fn.name:
+                continue
+            flagged_join = False
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"
+                    and isinstance(node.func.value, ast.Constant)
+                    and isinstance(node.func.value.value, (str, bytes))
+                    and len(node.func.value.value) > 0
+                ):
+                    flagged_join = True
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        f"delimiter join `{node.func.value.value!r}.join(...)` "
+                        "in a signing-bytes builder is not injective "
+                        "(re-framing forgery); pack fixed-width fields with "
+                        "struct instead",
+                    )
+            if flagged_join:
+                continue  # the join finding already covers its str() fields
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "encode"
+                    and isinstance(node.func.value, ast.Call)
+                    and mod.dotted(node.func.value.func) == "str"
+                ):
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        "variable-width `str(...).encode()` field in a "
+                        "signing-bytes builder; use fixed-width struct "
+                        "packing for injectivity",
+                    )
+
+
+_REGISTRY_NAME = re.compile(r"(^|_)(KIND|KINDS|CODE|CODES|REGISTRY)(_|$)")
+
+
+class KindCodeRule(Rule):
+    name = "wire-kind-dup"
+    description = "wire kind codes registered exactly once"
+    scope = ("protocol/",)
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        assigned: dict[str, int] = {}
+        # Module body plus class bodies: registries live at either level.
+        bodies = [mod.tree.body] + [
+            n.body for n in ast.walk(mod.tree) if isinstance(n, ast.ClassDef)
+        ]
+        for body in bodies:
+            for st in body:
+                if not isinstance(st, ast.Assign):
+                    continue
+                for t in st.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if not _REGISTRY_NAME.search(t.id):
+                        continue
+                    assigned[t.id] = assigned.get(t.id, 0) + 1
+                    if assigned[t.id] > 1:
+                        yield mod.finding(
+                            self.name,
+                            st,
+                            f"wire registry `{t.id}` is assigned more than "
+                            "once; kind codes must have a single source of "
+                            "truth",
+                        )
+                    if isinstance(st.value, ast.Dict):
+                        yield from self._check_dict(mod, t.id, st.value)
+
+    def _check_dict(
+        self, mod: ModuleInfo, name: str, node: ast.Dict
+    ) -> Iterable[Finding]:
+        seen_keys: dict[str, ast.AST] = {}
+        seen_vals: dict[object, ast.AST] = {}
+        for k, v in zip(node.keys, node.values):
+            if k is None:
+                continue  # ** expansion
+            key_repr = (
+                repr(k.value) if isinstance(k, ast.Constant) else ast.dump(k)
+            )
+            if key_repr in seen_keys:
+                yield mod.finding(
+                    self.name,
+                    k,
+                    f"wire registry `{name}` registers kind {key_repr} twice",
+                )
+            seen_keys[key_repr] = k
+            if isinstance(v, ast.Constant) and isinstance(v.value, (int, str, bytes)):
+                if v.value in seen_vals:
+                    yield mod.finding(
+                        self.name,
+                        v,
+                        f"wire registry `{name}` maps two kinds to the same "
+                        f"code {v.value!r}",
+                    )
+                seen_vals[v.value] = v
+
+
+register(StructFormatRule())
+register(SigningBytesRule())
+register(KindCodeRule())

@@ -23,7 +23,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "mode", nargs="?", default="run",
-        choices=["run", "serve", "bench", "report", "chaos"],
+        choices=["run", "serve", "bench", "report", "chaos", "lint"],
     )
     p.add_argument("--num-peers", type=int, default=8)
     p.add_argument("--trainers-per-round", type=int, default=3)
@@ -296,6 +296,26 @@ def build_parser() -> argparse.ArgumentParser:
         help="write the telemetry registry snapshot (counters/gauges/"
         "histograms JSON) here at exit; report mode reads it back",
     )
+    p.add_argument(
+        "--json", action="store_true", dest="lint_json",
+        help="lint mode: emit findings as a JSON document instead of text",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="lint mode: rewrite the baseline file to cover every current "
+        "finding (existing reasons preserved; new entries get a TODO "
+        "reason a human must replace)",
+    )
+    p.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help="lint mode: baseline file (default: the committed "
+        "p2pdl_tpu/analysis/baseline.json)",
+    )
+    p.add_argument(
+        "--lint-root", default=None, metavar="PATH",
+        help="lint mode: directory tree to lint (default: the installed "
+        "p2pdl_tpu package)",
+    )
     p.add_argument("--checkpoint-dir", default=None, help="checkpoint/resume directory")
     p.add_argument("--checkpoint-every", type=int, default=1, help="rounds between checkpoints")
     p.add_argument("--profile-dir", default=None, help="jax.profiler trace output dir")
@@ -551,6 +571,16 @@ def main(argv: list[str] | None = None) -> int:
     if args.mode == "report":
         # Pure host path: no jax/backend init, just JSONL + JSON rendering.
         return run_report(args)
+    if args.mode == "lint":
+        # Pure host path: p2plint is stdlib-ast only, no jax/backend init.
+        from p2pdl_tpu.analysis import cli_lint
+
+        return cli_lint(
+            root=args.lint_root,
+            baseline_path=args.baseline,
+            json_out=args.lint_json,
+            write_baseline=args.write_baseline,
+        )
     # Every other mode dispatches compiled programs — install the
     # shard_map/pcast aliases if this JAX build needs them (no-op otherwise).
     from p2pdl_tpu.utils import jax_compat

@@ -74,16 +74,33 @@ def _open_maybe_gz(path: str):
     return None
 
 
+def _read_exact(f, n: int) -> bytes:
+    """Read exactly ``n`` bytes or raise a ValueError naming the file —
+    a truncated download otherwise surfaces as an opaque struct.error."""
+    data = f.read(n)
+    if len(data) != n:
+        name = getattr(f, "name", None) or "<stream>"
+        raise ValueError(
+            f"{name}: truncated IDX file — expected {n} more byte(s), "
+            f"got {len(data)}; delete the file and re-download"
+        )
+    return data
+
+
 def _read_idx(f) -> np.ndarray:
     """Parse one IDX file (the MNIST container format): 2 zero bytes, dtype
     byte (0x08 = uint8), ndim byte, then ndim big-endian uint32 dims."""
-    zeros, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+    zeros, dtype_code, ndim = struct.unpack(">HBB", _read_exact(f, 4))
     if zeros != 0 or dtype_code != 0x08:
         raise ValueError(f"not a uint8 IDX file (magic {zeros:#x}/{dtype_code:#x})")
-    dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+    dims = struct.unpack(f">{ndim}I", _read_exact(f, 4 * ndim))
     data = np.frombuffer(f.read(), dtype=np.uint8)
     if data.size != int(np.prod(dims)):
-        raise ValueError(f"IDX payload {data.size} != {dims}")
+        name = getattr(f, "name", None) or "<stream>"
+        raise ValueError(
+            f"{name}: IDX payload has {data.size} byte(s), dims {dims} "
+            f"need {int(np.prod(dims))}"
+        )
     return data.reshape(dims)
 
 

@@ -66,6 +66,7 @@ class _HmacPrivateKey:
     __slots__ = ("_secret",)
 
     def __init__(self, secret: bytes | None = None) -> None:
+        # p2plint: disable=determinism-entropy -- sanctioned: signing-key generation; keys are identity, not replayed state
         self._secret = secret if secret is not None else os.urandom(32)
 
     def sign(self, data: bytes) -> bytes:

@@ -131,6 +131,7 @@ def generate_agreement_key():
     """Fresh agreement private key (P-256, or fallback DH) from OS entropy."""
     if HAVE_CRYPTOGRAPHY:
         return ec.generate_private_key(ec.SECP256R1())
+    # p2plint: disable=determinism-entropy -- sanctioned: agreement-key generation; keys are identity, not replayed state
     return _DhPrivateKey(_secrets.randbelow(shamir.P256_ORDER - 1) + 1)
 
 

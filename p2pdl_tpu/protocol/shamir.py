@@ -56,6 +56,7 @@ def split_secret(
         raise ValueError(f"need 1 <= threshold({threshold}) <= n_shares({n_shares})")
     if n_shares >= q:  # unreachable for P-256 but keeps the math honest
         raise ValueError("n_shares must be < field size")
+    # p2plint: disable=determinism-entropy -- sanctioned: secret-sharing blinding polynomial must be cryptographically random; callers needing replay pass rng=
     draw = (lambda: rng.randrange(q)) if rng is not None else (lambda: secrets.randbelow(q))
     coeffs = [secret] + [draw() for _ in range(threshold - 1)]
     return [(x, _eval_poly(coeffs, x, q)) for x in range(1, n_shares + 1)]

@@ -115,10 +115,13 @@ class Cluster:
         """Trainer/tester split for the next round (reference ``main.py:52-54``).
         Resets any stale consent from an abandoned round: set_start_learning
         calls only count toward the round they were sampled for."""
-        with self._lock:
-            self._pending_trainers.clear()
         trainers = self.experiment.sample_roles().tolist()
-        self._expected_trainers = trainers
+        with self._lock:
+            # One critical section: a consent arriving mid-reset must see
+            # either the old round's full state or the new round's, never a
+            # cleared pending-set with a stale expected list.
+            self._pending_trainers.clear()
+            self._expected_trainers = trainers
         testers = [i for i in range(self.cfg.num_peers) if i not in trainers]
         return [self.nodes[i] for i in trainers], [self.nodes[i] for i in testers]
 
@@ -186,7 +189,8 @@ class Cluster:
             trainers = self.experiment.sample_roles().tolist()
         if all(t in self._stopped for t in trainers):
             raise RuntimeError("every sampled trainer is stopped")
-        self._expected_trainers = trainers
+        with self._lock:
+            self._expected_trainers = trainers
         before = len(self.experiment.records)
         for node in self.nodes:
             node.reset_delivered_flag()

@@ -1,0 +1,176 @@
+"""The tier-1 p2plint gate: the package tree must be clean modulo the
+committed, fully-justified baseline — and the CLI must fail on known-bad
+trees.
+
+This is the module that turns the four invariant families (determinism,
+host-sync, lock discipline, wire conformance) into a property of every
+verify run: a new unsanctioned `time.time()` in `protocol/`, a stray
+`.item()` in the driver, a delimiter-joined signing encoding, or an
+unlocked write to shared hub state fails the suite.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from p2pdl_tpu.analysis import run_lint
+from p2pdl_tpu.analysis.engine import DEFAULT_BASELINE_PATH, TODO_REASON, load_baseline
+from p2pdl_tpu.cli import main as cli_main
+
+
+def test_tree_is_clean_modulo_baseline():
+    result = run_lint()
+    lines = [
+        f"{f.path}:{f.line}: {f.rule}: {f.message}" for f in result.new
+    ]
+    assert result.new == [], (
+        "p2plint found unsanctioned findings — fix them, add an inline "
+        "`# p2plint: disable=<rule> -- reason`, or justify them in the "
+        "baseline:\n" + "\n".join(lines)
+    )
+
+
+def test_no_stale_baseline_entries():
+    result = run_lint()
+    assert result.stale_entries == [], (
+        "baseline entries no longer match any finding — the code moved on; "
+        "regenerate with `python -m p2pdl_tpu.cli lint --write-baseline`:\n"
+        + "\n".join(str(e) for e in result.stale_entries)
+    )
+
+
+def test_every_baseline_entry_is_justified():
+    entries = load_baseline(DEFAULT_BASELINE_PATH)
+    assert entries, "the committed baseline should exist and be non-empty"
+    for e in entries:
+        reason = e.get("reason", "")
+        assert reason and reason != TODO_REASON, (
+            f"baseline entry for {e.get('rule')} @ {e.get('path')} "
+            f"[{e.get('context')}] has no real justification"
+        )
+
+
+def test_cli_lint_exits_zero_on_tree(capsys):
+    assert cli_main(["lint"]) == 0
+    out = capsys.readouterr().out
+    assert "0 new finding(s)" in out
+
+
+def test_cli_lint_json_output(capsys):
+    assert cli_main(["lint", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["exit_code"] == 0
+    assert doc["new_findings"] == []
+    assert doc["files_scanned"] > 0
+    assert doc["stale_baseline_entries"] == []
+
+
+# ---- known-bad fixture trees must fail the CLI ------------------------------
+
+BAD_FIXTURES = {
+    "determinism": (
+        "protocol/bad_determinism.py",
+        """
+        import time
+
+        def stamp():
+            return time.time()
+        """,
+    ),
+    "hostsync": (
+        "runtime/driver.py",
+        """
+        def readback(arr):
+            return arr.item()
+        """,
+    ),
+    "locks": (
+        "runtime/bad_locks.py",
+        """
+        import threading
+
+        class Hub:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = []
+
+            def locked_put(self, item):
+                with self._lock:
+                    self._queue.append(item)
+
+            def racy_put(self, item):
+                self._queue.append(item)
+        """,
+    ),
+    "wire": (
+        "protocol/bad_signing.py",
+        """
+        class BRBBatch:
+            def signing_bytes(self):
+                parts = [self.kind.encode(), str(self.from_id).encode()]
+                for sender, digest in self.items:
+                    parts.append(str(sender).encode())
+                    parts.append(digest)
+                return b"|".join(parts)
+        """,
+    ),
+}
+
+
+@pytest.mark.parametrize("family", sorted(BAD_FIXTURES))
+def test_cli_lint_fails_on_known_bad_fixture(tmp_path, capsys, family):
+    relpath, src = BAD_FIXTURES[family]
+    target = tmp_path / relpath
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(src))
+    rc = cli_main(
+        [
+            "lint",
+            "--lint-root",
+            str(tmp_path),
+            "--baseline",
+            str(tmp_path / "no-baseline.json"),
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1, f"{family}: expected a lint failure, got:\n{out}"
+
+
+def test_cli_lint_flags_delimiter_join_forgery_as_wire_rule(tmp_path, capsys):
+    """Acceptance: the PR 4 signing_bytes delimiter-join forgery fixture is
+    flagged specifically by the wire-conformance rule."""
+    relpath, src = BAD_FIXTURES["wire"]
+    target = tmp_path / relpath
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(src))
+    rc = cli_main(
+        [
+            "lint",
+            "--json",
+            "--lint-root",
+            str(tmp_path),
+            "--baseline",
+            str(tmp_path / "no-baseline.json"),
+        ]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["rule"] for f in doc["new_findings"]} == {"wire-signing"}
+    assert "not injective" in doc["new_findings"][0]["message"]
+
+
+def test_cli_write_baseline_round_trip(tmp_path, capsys):
+    """--write-baseline makes a dirty fixture tree pass on the next run."""
+    relpath, src = BAD_FIXTURES["determinism"]
+    target = tmp_path / relpath
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(src))
+    baseline = str(tmp_path / "baseline.json")
+    lint_args = ["lint", "--lint-root", str(tmp_path), "--baseline", baseline]
+    assert cli_main(lint_args) == 1
+    assert cli_main(lint_args + ["--write-baseline"]) == 0
+    capsys.readouterr()
+    assert cli_main(lint_args) == 0
+    out = capsys.readouterr().out
+    assert "1 baselined" in out

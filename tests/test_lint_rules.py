@@ -1,0 +1,586 @@
+"""p2plint rule fixtures: known-good / known-bad snippets per rule family,
+suppression honoring, baseline round-trip, and the PR 4 signing-bytes
+forgery regression.
+
+Everything here runs the engine over in-memory source (``lint_source``)
+with scope-matching relative paths — no filesystem tree and no jax, so the
+module is pure tier-1.
+"""
+
+import textwrap
+
+import pytest
+
+from p2pdl_tpu.analysis import engine
+from p2pdl_tpu.analysis.engine import (
+    TODO_REASON,
+    apply_baseline,
+    lint_source,
+    load_baseline,
+    write_baseline_file,
+)
+
+
+def lint(src: str, relpath: str = "protocol/fake.py"):
+    return lint_source(textwrap.dedent(src), relpath)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---- determinism ------------------------------------------------------------
+
+
+def test_wallclock_flagged_in_replay_scope():
+    findings = lint(
+        """
+        import time
+
+        def stamp():
+            return time.time()
+        """
+    )
+    assert rules_of(findings) == {"determinism-wallclock"}
+    assert "time.time" in findings[0].message
+    assert findings[0].context == "stamp"
+
+
+def test_perf_counter_and_out_of_scope_wallclock_are_clean():
+    src = """
+        import time
+
+        def stamp():
+            return time.perf_counter()
+        """
+    assert lint(src) == []
+    # time.time is fine outside the replay-critical scope.
+    assert lint("import time\nx = time.time()\n", "utils/fake.py") == []
+
+
+def test_datetime_now_flagged_even_via_alias():
+    findings = lint(
+        """
+        from datetime import datetime as dt
+
+        def stamp():
+            return dt.now()
+        """
+    )
+    assert rules_of(findings) == {"determinism-wallclock"}
+
+
+def test_entropy_flagged_including_aliased_secrets():
+    findings = lint(
+        """
+        import os
+        import secrets as s
+
+        def keygen():
+            return os.urandom(32) + s.token_bytes(8)
+        """
+    )
+    assert [f.rule for f in findings] == ["determinism-entropy"] * 2
+
+
+def test_unseeded_rng_flagged_seeded_clean():
+    bad = lint(
+        """
+        import numpy as np
+
+        def draw():
+            return np.random.default_rng().integers(10)
+        """
+    )
+    assert rules_of(bad) == {"determinism-entropy"}
+    good = lint(
+        """
+        import numpy as np
+
+        def draw(seed):
+            return np.random.default_rng([seed, 3]).integers(10)
+        """
+    )
+    assert good == []
+
+
+def test_global_rng_draw_flagged():
+    findings = lint(
+        """
+        import random
+        import numpy as np
+
+        def draw():
+            return random.random() + np.random.rand()
+        """
+    )
+    assert [f.rule for f in findings] == ["determinism-entropy"] * 2
+
+
+def test_set_iteration_flagged_sorted_clean():
+    bad = lint(
+        """
+        def walk(peers):
+            out = []
+            for p in set(peers):
+                out.append(p)
+            return out, list({1, 2}), [x for x in frozenset(peers)]
+        """
+    )
+    assert [f.rule for f in bad] == ["determinism-set-order"] * 3
+    good = lint(
+        """
+        def walk(peers):
+            out = []
+            for p in sorted(set(peers)):
+                out.append(p)
+            return out
+        """
+    )
+    assert good == []
+
+
+# ---- hostsync ---------------------------------------------------------------
+
+HOSTSYNC_PATH = "runtime/driver.py"
+
+
+def test_hostsync_transfers_flagged():
+    findings = lint(
+        """
+        import jax
+        import numpy as np
+
+        def readback(arr, losses_dev):
+            a = np.asarray(arr)
+            b = jax.device_get(arr)
+            c = arr.item()
+            d = float(losses_dev)
+            return a, b, c, d
+        """,
+        HOSTSYNC_PATH,
+    )
+    assert [f.rule for f in findings] == ["hostsync-transfer"] * 4
+
+
+def test_hostsync_jnp_asarray_and_plain_casts_clean():
+    findings = lint(
+        """
+        import jax.numpy as jnp
+
+        def to_device(host_list, n):
+            return jnp.asarray(host_list), float(n), int(len(host_list))
+        """,
+        HOSTSYNC_PATH,
+    )
+    assert findings == []
+
+
+def test_hostsync_scoped_to_driver_and_round():
+    src = """
+        import numpy as np
+
+        def f(x):
+            return np.asarray(x)
+        """
+    assert lint(src, "protocol/brb.py") == []
+    assert rules_of(lint(src, "parallel/round.py")) == {"hostsync-transfer"}
+
+
+# ---- lock discipline --------------------------------------------------------
+
+
+def test_mixed_lock_writes_flagged():
+    findings = lint(
+        """
+        import threading
+
+        class Hub:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = []
+
+            def locked_put(self, item):
+                with self._lock:
+                    self._queue.append(item)
+
+            def racy_put(self, item):
+                self._queue.append(item)
+        """,
+        "runtime/fake.py",
+    )
+    assert rules_of(findings) == {"lock-discipline"}
+    assert "_queue" in findings[0].message and "Hub" in findings[0].message
+    assert findings[0].context == "Hub.racy_put"
+
+
+def test_consistent_lock_usage_clean():
+    findings = lint(
+        """
+        import threading
+
+        class Hub:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = []
+                self._stats = {}
+
+            def put(self, item):
+                with self._lock:
+                    self._queue.append(item)
+                    self._stats[item] = 1
+
+            def rename(self, name):
+                # written only outside the lock: single-threaded by design
+                self.name = name
+        """,
+        "runtime/fake.py",
+    )
+    assert findings == []
+
+
+def test_init_writes_are_exempt():
+    findings = lint(
+        """
+        import threading
+
+        class Hub:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = []  # pre-sharing write, not a race
+
+            def put(self, item):
+                with self._lock:
+                    self._queue.append(item)
+        """,
+        "runtime/fake.py",
+    )
+    assert findings == []
+
+
+def test_module_global_lock_discipline():
+    findings = lint(
+        """
+        import threading
+
+        _POOL = None
+        _POOL_LOCK = threading.Lock()
+
+        def good():
+            global _POOL
+            with _POOL_LOCK:
+                if _POOL is None:
+                    _POOL = object()
+            return _POOL
+
+        def bad():
+            global _POOL
+            _POOL = None
+        """,
+        "runtime/fake.py",
+    )
+    assert rules_of(findings) == {"lock-discipline"}
+    assert "_POOL" in findings[0].message
+
+
+# ---- wire conformance -------------------------------------------------------
+
+
+def test_struct_pack_arg_count_mismatch_flagged():
+    findings = lint(
+        """
+        import struct
+
+        def frame(a, b):
+            return struct.pack(">IH", a, b, 3)
+        """
+    )
+    assert rules_of(findings) == {"wire-struct"}
+    assert "consumes 2" in findings[0].message
+
+
+def test_struct_pack_s_code_counts_one_value():
+    good = lint(
+        """
+        import struct
+
+        def frame(code, n):
+            return struct.pack(">4sBI", b"BRB2", code, n)
+        """
+    )
+    assert good == []
+
+
+def test_struct_unpack_read_length_mismatch_flagged():
+    findings = lint(
+        """
+        import struct
+
+        def parse(f):
+            return struct.unpack(">IH", f.read(4))
+        """
+    )
+    assert rules_of(findings) == {"wire-struct"}
+    assert "needs exactly 6" in findings[0].message
+    good = lint(
+        """
+        import struct
+
+        def parse(f):
+            return struct.unpack(">IH", f.read(6))
+        """
+    )
+    assert good == []
+
+
+def test_struct_unpack_read_exact_helper_checked():
+    findings = lint(
+        """
+        import struct
+
+        def parse(f):
+            return struct.unpack(">HBB", _read_exact(f, 3))
+        """
+    )
+    assert rules_of(findings) == {"wire-struct"}
+
+
+def test_invalid_struct_format_flagged():
+    findings = lint(
+        """
+        import struct
+
+        def parse(buf):
+            return struct.unpack(">Z", buf)
+        """
+    )
+    assert rules_of(findings) == {"wire-struct"}
+    assert "invalid struct format" in findings[0].message
+
+
+def test_kind_code_registries():
+    findings = lint(
+        """
+        _KIND_CODE = {"echo": 1, "ready": 1}
+        """
+    )
+    assert rules_of(findings) == {"wire-kind-dup"}
+    assert "same" in findings[0].message
+    findings = lint(
+        """
+        _KIND_CODE = {"echo": 1, "ready": 2}
+        _KIND_CODE = {"echo": 1}
+        """
+    )
+    assert any("assigned more than once" in f.message for f in findings)
+    assert lint('_KIND_CODE = {"echo": 1, "ready": 2}\n') == []
+
+
+def test_kind_dup_scoped_to_protocol():
+    assert lint('_KIND_CODE = {"a": 1, "b": 1}\n', "runtime/fake.py") == []
+
+
+# ---- the PR 4 signing-bytes forgery regression ------------------------------
+
+# Shape of the v1 BRBBatch.signing_bytes that PR 4's review found forgeable:
+# variable-width decimal fields joined with b"|" let one signed byte string
+# describe two different (sender, digest) framings.
+FORGEABLE_SIGNING = """
+    class BRBBatch:
+        def signing_bytes(self):
+            parts = [self.kind.encode(), str(self.from_id).encode()]
+            for sender, digest in self.items:
+                parts.append(str(sender).encode())
+                parts.append(digest)
+            return b"|".join(parts)
+    """
+
+# The fix that PR 4 shipped: fixed-width struct fields, empty-join.
+FIXED_WIDTH_SIGNING = """
+    import struct
+
+    class BRBBatch:
+        def signing_bytes(self):
+            head = struct.pack(
+                ">4sBqqI", b"BRB2", self.code, self.from_id, self.seq, len(self.items)
+            )
+            parts = [head]
+            for sender, digest in self.items:
+                parts.append(struct.pack(">q", sender))
+                parts.append(digest)
+            return b"".join(parts)
+    """
+
+
+def test_delimiter_join_signing_forgery_flagged():
+    findings = lint(FORGEABLE_SIGNING, "protocol/brb.py")
+    assert rules_of(findings) == {"wire-signing"}
+    assert "not injective" in findings[0].message
+    assert findings[0].context == "BRBBatch.signing_bytes"
+
+
+def test_fixed_width_signing_clean():
+    assert lint(FIXED_WIDTH_SIGNING, "protocol/brb.py") == []
+
+
+def test_str_encode_field_flagged_without_join():
+    findings = lint(
+        """
+        import struct
+
+        def signing_bytes(self):
+            return struct.pack(">I", self.seq) + str(self.sender).encode()
+        """,
+        "protocol/fake.py",
+    )
+    assert rules_of(findings) == {"wire-signing"}
+    assert "variable-width" in findings[0].message
+
+
+# ---- suppressions -----------------------------------------------------------
+
+
+def test_same_line_suppression():
+    findings = lint(
+        """
+        import time
+
+        def stamp():
+            return time.time()  # p2plint: disable=determinism-wallclock -- test fixture
+        """
+    )
+    assert findings == []
+
+
+def test_previous_line_standalone_suppression():
+    findings = lint(
+        """
+        import time
+
+        def stamp():
+            # p2plint: disable=determinism-wallclock -- test fixture
+            return time.time()
+        """
+    )
+    assert findings == []
+
+
+def test_wrong_rule_suppression_does_not_apply():
+    findings = lint(
+        """
+        import time
+
+        def stamp():
+            return time.time()  # p2plint: disable=determinism-entropy
+        """
+    )
+    assert rules_of(findings) == {"determinism-wallclock"}
+
+
+def test_file_level_and_all_suppressions():
+    findings = lint(
+        """
+        # p2plint: disable-file=determinism-wallclock
+        import time
+        import os
+
+        def stamp():
+            return time.time(), os.urandom(4)  # p2plint: disable=all
+        """
+    )
+    assert findings == []
+
+
+def test_parse_error_reported_as_finding():
+    findings = lint_source("def broken(:\n", "protocol/broken.py")
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+# ---- baseline round-trip ----------------------------------------------------
+
+
+def _some_findings():
+    return lint(
+        """
+        import time
+
+        def stamp():
+            return time.time()
+        """
+    )
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = _some_findings()
+    path = str(tmp_path / "baseline.json")
+    n = write_baseline_file(path, findings)
+    assert n == 1
+    entries = load_baseline(path)
+    assert entries[0]["reason"] == TODO_REASON
+    new, baselined, stale = apply_baseline(findings, entries)
+    assert new == [] and len(baselined) == 1 and stale == []
+
+
+def test_baseline_is_line_number_independent(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    write_baseline_file(path, _some_findings())
+    shifted = lint(
+        """
+        import time
+
+        # an unrelated edit pushed the finding down two lines
+
+        def stamp():
+            return time.time()
+        """
+    )
+    new, baselined, stale = apply_baseline(shifted, load_baseline(path))
+    assert new == [] and len(baselined) == 1 and stale == []
+
+
+def test_baseline_stale_entry_detected(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    write_baseline_file(path, _some_findings())
+    new, baselined, stale = apply_baseline([], load_baseline(path))
+    assert new == [] and baselined == [] and len(stale) == 1
+
+
+def test_baseline_rewrite_preserves_reasons(tmp_path):
+    import json
+
+    path = str(tmp_path / "baseline.json")
+    findings = _some_findings()
+    write_baseline_file(path, findings)
+    doc = json.load(open(path))
+    doc["entries"][0]["reason"] = "hand-written justification"
+    json.dump(doc, open(path, "w"))
+    write_baseline_file(path, findings, load_baseline(path))
+    assert load_baseline(path)[0]["reason"] == "hand-written justification"
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    assert load_baseline(str(tmp_path / "nope.json")) == []
+
+
+def test_malformed_baseline_raises(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text('{"not-entries": []}')
+    with pytest.raises(ValueError, match="baseline"):
+        load_baseline(str(p))
+
+
+# ---- engine odds and ends ---------------------------------------------------
+
+
+def test_rule_names_are_unique_and_scopes_normalized():
+    names = [r.name for r in engine.all_rules()]
+    assert len(names) == len(set(names))
+    # The package-prefix strip: a fixture tree rooted above p2pdl_tpu/ and
+    # one rooted at the package both hit the same scopes.
+    src = "import time\nx = time.time()\n"
+    assert rules_of(lint_source(src, "p2pdl_tpu/protocol/fake.py")) == {
+        "determinism-wallclock"
+    }
+    assert rules_of(lint_source(src, "protocol/fake.py")) == {
+        "determinism-wallclock"
+    }

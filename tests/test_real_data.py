@@ -183,3 +183,37 @@ def test_corrupt_idx_rejected(tmp_path):
     with open(p, "rb") as f:
         with pytest.raises(ValueError, match="IDX"):
             real._read_idx(f)
+
+
+def test_truncated_idx_header_names_file_and_count(tmp_path):
+    """A short read must raise a clear ValueError naming the file and the
+    missing byte count, not an opaque struct.error."""
+    p = tmp_path / "trunc-header"
+    p.write_bytes(b"\x00\x08")  # 2 of the 4 header bytes
+    with open(p, "rb") as f:
+        with pytest.raises(ValueError) as exc:
+            real._read_idx(f)
+    msg = str(exc.value)
+    assert "truncated" in msg
+    assert str(p) in msg
+    assert "4" in msg and "got 2" in msg
+
+
+def test_truncated_idx_dims_rejected(tmp_path):
+    p = tmp_path / "trunc-dims"
+    # Header promises 3 dims; only one uint32 follows.
+    p.write_bytes(struct.pack(">HBB", 0, 0x08, 3) + struct.pack(">I", 10))
+    with open(p, "rb") as f:
+        with pytest.raises(ValueError, match=r"expected 12 more byte\(s\), got 4"):
+            real._read_idx(f)
+
+
+def test_short_idx_payload_names_file(tmp_path):
+    p = tmp_path / "short-payload"
+    # Valid header for a (2, 3) uint8 array, but only 4 of 6 payload bytes.
+    p.write_bytes(struct.pack(">HBB", 0, 0x08, 2) + struct.pack(">II", 2, 3) + b"\x01" * 4)
+    with open(p, "rb") as f:
+        with pytest.raises(ValueError) as exc:
+            real._read_idx(f)
+    assert str(p) in str(exc.value)
+    assert "need 6" in str(exc.value)
