@@ -480,6 +480,71 @@ def telemetry_block() -> dict:
     }
 
 
+def flight_block() -> dict:
+    """The bench JSON's ``flight`` block: event mix, anomaly counts, and
+    the determinism digest from a flight-recorded host-only BRB probe.
+
+    Mirrors :func:`telemetry_block` (no device work), but with the flight
+    recorder enabled around the round: one clean delivery plus one forced
+    anomaly (a malformed batch item) so the block proves both the happy
+    path (init -> echo -> ready -> deliver timeline) and the
+    dump-on-anomaly accounting. The recorder's prior state is restored
+    afterwards — the probe never leaks events into a caller's recording.
+    """
+    import hashlib
+
+    from p2pdl_tpu.runtime.driver import _TrustPlane
+    from p2pdl_tpu.utils import flight
+
+    rec = flight.recorder()
+    prior_enabled = rec.enabled
+    prior_events = rec.events()
+    rec.reset()
+    rec.enabled = True
+    try:
+        cfg = Config(num_peers=8, trainers_per_round=3, byzantine_f=1)
+        trainers = [0, 3, 5]
+        plane = _TrustPlane(cfg)
+        digests = {
+            t: hashlib.sha256(b"flight-probe-%d" % t).digest() for t in trainers
+        }
+        t0 = time.perf_counter()
+        delivered, _failed, verified = plane.run_round(0, trainers, digests)
+        wall_s = time.perf_counter() - t0
+        # Forced anomaly: a batch item carrying a truncated digest is
+        # rejected before any crypto and raises `batch_rejected`.
+        from p2pdl_tpu.protocol.brb import ECHO, BRBBatch
+
+        bad = BRBBatch(kind=ECHO, from_id=1, seq=0, items=((0, b"short"),))
+        plane.broadcasters[2].handle_batch(bad)
+        summary = rec.summary()
+        timeline = rec.instance_timeline(trainers[0], 0)
+        return {
+            "probe": {
+                "peers": cfg.num_peers,
+                "trainers": len(trainers),
+                "peers_delivered": delivered,
+                "trainers_verified": len(verified),
+                "wall_s": round(wall_s, 4),
+            },
+            "events_recorded": summary["events_recorded"],
+            "kinds": summary["kinds"],
+            "anomaly_count": summary["anomaly_count"],
+            "anomalies_by_kind": summary["anomalies_by_kind"],
+            "determinism_digest": rec.determinism_digest(),
+            "timeline_sample": [
+                {k: v for k, v in ev.items() if k in ("kind", "votes", "quorum", "margin")}
+                for ev in timeline[:8]
+            ],
+        }
+    finally:
+        rec.reset()
+        rec.enabled = prior_enabled
+        if prior_events:
+            with rec._lock:
+                rec._ring.extend(prior_events)
+
+
 def faults_block(plan_name: str = "crash_drop_partition") -> dict:
     """The bench JSON's ``faults`` block: chaos-plane survival counts from
     a host-only probe (no device work, mirroring :func:`telemetry_block`).
@@ -1316,6 +1381,11 @@ def main() -> None:
         rec["faults"] = faults_block(plan_name)
     except Exception as e:  # noqa: BLE001 - headline must still print
         rec["faults"] = {"error": str(e)[:300]}
+    # Flight-recorder probe (ISSUE 6), same degrade contract.
+    try:
+        rec["flight"] = flight_block()
+    except Exception as e:  # noqa: BLE001 - headline must still print
+        rec["flight"] = {"error": str(e)[:300]}
     print(json.dumps(rec))
 
 

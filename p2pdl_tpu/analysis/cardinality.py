@@ -1,0 +1,138 @@
+"""Telemetry-cardinality rules: metric labels must stay low-cardinality.
+
+The registry caps each metric at ``DEFAULT_MAX_SERIES_PER_METRIC`` series
+and folds the overflow into ``__other__`` — so a per-peer-id, per-digest,
+or per-round label doesn't crash anything, it silently *destroys the
+metric*: past the cap every new identity lands in one aggregate bucket
+and the dashboard lies. Two rules over ``protocol/``, ``parallel/``, and
+``runtime/``:
+
+- ``telemetry-cardinality``: a ``telemetry.counter/gauge/histogram`` call
+  whose label keyword is identity-named (``peer``, ``sender``, ``digest``,
+  ``round``, ...) with a non-constant value. A constant (``peer="all"``)
+  is a fixed partition, fine; a variable (``peer=pid``) mints one series
+  per identity. Deliberate bounded cases (e.g. O(num_peers) series for a
+  per-peer failure panel) carry an inline
+  ``# p2plint: disable=telemetry-cardinality -- reason`` suppression.
+- ``telemetry-label-splat``: ``**kwargs`` splatted into the label set —
+  the label keys themselves become data-dependent, which no reviewer can
+  bound by reading the call site.
+
+The ``bounds`` keyword of ``histogram`` is the bucket config, not a
+label, and is never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from p2pdl_tpu.analysis.engine import Finding, ModuleInfo, Rule, register
+
+METRIC_SCOPE = ("protocol/", "parallel/", "runtime/")
+
+# Metric factory call targets: module-level helpers and registry methods.
+_METRIC_FNS = ("counter", "gauge", "histogram")
+
+# Label names that name an identity or an unbounded sequence: one series
+# per peer/digest/round is exactly the cardinality explosion the registry
+# cap exists to contain.
+_IDENTITY_LABELS = {
+    "peer",
+    "peer_id",
+    "trainer",
+    "sender",
+    "src",
+    "dst",
+    "node",
+    "node_id",
+    "id",
+    "digest",
+    "hash",
+    "addr",
+    "host",
+    "port",
+    "seq",
+    "round",
+    "round_idx",
+    "step",
+}
+
+# Keywords that are factory config, not labels.
+_NON_LABEL_KWARGS = {"bounds"}
+
+
+def _is_metric_call(mod: ModuleInfo, node: ast.Call) -> str | None:
+    """Return the factory name (``counter``/...) when ``node`` constructs a
+    telemetry series, else None. Matches ``telemetry.counter(...)``,
+    ``MetricsRegistry``-style ``<obj>.counter(...)``, and a bare
+    ``counter(...)`` imported from the telemetry module."""
+    dotted = mod.dotted(node.func)
+    if dotted is not None:
+        parts = dotted.split(".")
+        if parts[-1] in _METRIC_FNS and (
+            len(parts) == 1 or "telemetry" in parts[0].lower() or "registry" in parts[0].lower()
+        ):
+            return parts[-1]
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _METRIC_FNS:
+        # Method call on an unresolvable receiver (e.g. ``self._registry``):
+        # still a metric factory by naming convention.
+        return node.func.attr
+    return None
+
+
+def _is_constant_label(value: ast.AST) -> bool:
+    return isinstance(value, ast.Constant)
+
+
+class CardinalityRule(Rule):
+    name = "telemetry-cardinality"
+    description = "identity-valued metric label mints unbounded series"
+    scope = METRIC_SCOPE
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _is_metric_call(mod, node)
+            if fn is None:
+                continue
+            for kw in node.keywords:
+                if kw.arg is None or kw.arg in _NON_LABEL_KWARGS:
+                    continue
+                if kw.arg in _IDENTITY_LABELS and not _is_constant_label(kw.value):
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        f"`{fn}(...)` labels by identity `{kw.arg}=<expr>`: "
+                        "one series per value, folded to `__other__` past "
+                        "the registry cap; aggregate instead, or suppress "
+                        "with a bounded-cardinality justification",
+                    )
+
+
+class LabelSplatRule(Rule):
+    name = "telemetry-label-splat"
+    description = "**kwargs splat into a metric label set"
+    scope = METRIC_SCOPE
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _is_metric_call(mod, node)
+            if fn is None:
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        f"`{fn}(...)` splats `**` into its label set: the "
+                        "label keys become data-dependent and unbounded; "
+                        "spell each label explicitly",
+                    )
+
+
+register(CardinalityRule())
+register(LabelSplatRule())

@@ -250,7 +250,13 @@ def register(rule: Rule) -> Rule:
 def all_rules() -> list[Rule]:
     """Every registered rule, rule modules imported on first use."""
     if not _RULES:
-        from p2pdl_tpu.analysis import determinism, hostsync, locks, wire  # noqa: F401
+        from p2pdl_tpu.analysis import (  # noqa: F401
+            cardinality,
+            determinism,
+            hostsync,
+            locks,
+            wire,
+        )
 
     return list(_RULES.values())
 

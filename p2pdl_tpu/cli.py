@@ -23,7 +23,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "mode", nargs="?", default="run",
-        choices=["run", "serve", "bench", "report", "chaos", "lint"],
+        choices=["run", "serve", "serve-metrics", "bench", "report", "chaos", "lint"],
     )
     p.add_argument("--num-peers", type=int, default=8)
     p.add_argument("--trainers-per-round", type=int, default=3)
@@ -298,7 +298,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--json", action="store_true", dest="lint_json",
-        help="lint mode: emit findings as a JSON document instead of text",
+        help="lint mode: emit findings as a JSON document instead of text; "
+        "report mode: emit the digest as machine-readable JSON instead of "
+        "Markdown (same sections, same numbers)",
+    )
+    p.add_argument(
+        "--flight-path", default=None, metavar="PATH",
+        help="flight-recorder JSONL: run/chaos modes enable the recorder "
+        "and dump its ring here at exit; report mode folds the dump into "
+        "a '## Flight recorder' section; serve-metrics loads it so "
+        "/flight serves a recorded run",
     )
     p.add_argument(
         "--write-baseline", action="store_true",
@@ -468,36 +477,51 @@ def _fmt(v) -> str:
     return str(v)
 
 
-def render_report(records: list[dict], telemetry_snapshot: dict | None = None) -> str:
-    """Markdown digest of a metrics JSONL + optional telemetry snapshot.
+def flight_summary_from_events(events: list[dict]) -> dict:
+    """Summarize a dumped flight JSONL (kind mix + anomaly counts) — the
+    offline twin of ``FlightRecorder.summary()`` for report mode."""
+    kinds: dict[str, int] = {}
+    anomalies: dict[str, int] = {}
+    for ev in events:
+        kind = ev.get("kind", "?")
+        kinds[kind] = kinds.get(kind, 0) + 1
+        if ev.get("anomaly"):
+            anomalies[kind] = anomalies.get(kind, 0) + 1
+    return {
+        "events": len(events),
+        "kinds": dict(sorted(kinds.items())),
+        "anomaly_count": sum(anomalies.values()),
+        "anomalies_by_kind": dict(sorted(anomalies.items())),
+    }
 
-    Pure host-side rendering: no jax import, so ``report`` runs anywhere
-    the JSONL landed (a laptop, a CI artifact view) without a backend.
-    """
-    lines = ["# p2pdl_tpu run report", ""]
+
+def build_report_data(
+    records: list[dict],
+    telemetry_snapshot: dict | None = None,
+    flight_summary: dict | None = None,
+) -> dict:
+    """The report's numbers as one JSON-ready dict — the Markdown digest
+    and ``report --json`` both render from this, so they can never drift."""
+    data: dict = {}
     rounds = [r for r in records if "round" in r]
     if rounds:
         evals = [r for r in rounds if r.get("eval_acc") is not None]
         durations = [r["duration_s"] for r in rounds if r.get("duration_s")]
-        total_s = sum(durations)
         # Steady-state throughput excludes the first round (jit compile).
         steady = durations[1:] if len(durations) > 1 else durations
-        rows = [
-            ["rounds", _fmt(len(rounds))],
-            ["train loss (first -> last)",
-             f"{_fmt(rounds[0].get('train_loss'))} -> {_fmt(rounds[-1].get('train_loss'))}"],
-            ["final eval acc", _fmt(evals[-1]["eval_acc"] if evals else None)],
-            ["best eval acc",
-             _fmt(max(r["eval_acc"] for r in evals) if evals else None)],
-            ["final eval loss", _fmt(evals[-1]["eval_loss"] if evals else None)],
-            ["total wall time (s)", _fmt(total_s)],
-            ["first round (s, incl. compile)",
-             _fmt(durations[0] if durations else None)],
-            ["steady rounds/sec",
-             _fmt(len(steady) / sum(steady) if steady and sum(steady) > 0 else None)],
-        ]
-        lines += ["## Rounds", ""] + _md_table(["metric", "value"], rows) + [""]
-
+        data["rounds"] = {
+            "count": len(rounds),
+            "train_loss_first": rounds[0].get("train_loss"),
+            "train_loss_last": rounds[-1].get("train_loss"),
+            "final_eval_acc": evals[-1]["eval_acc"] if evals else None,
+            "best_eval_acc": max(r["eval_acc"] for r in evals) if evals else None,
+            "final_eval_loss": evals[-1]["eval_loss"] if evals else None,
+            "total_wall_s": sum(durations),
+            "first_round_s": durations[0] if durations else None,
+            "steady_rounds_per_sec": (
+                len(steady) / sum(steady) if steady and sum(steady) > 0 else None
+            ),
+        }
         brb_rounds = [r for r in rounds if r.get("brb_delivered") is not None]
         if brb_rounds:
             failed: dict[int, int] = {}
@@ -507,23 +531,126 @@ def render_report(records: list[dict], telemetry_snapshot: dict | None = None) -
                     failed[p] = failed.get(p, 0) + 1
                 for t in r.get("brb_excluded_trainers") or []:
                     excluded[t] = excluded.get(t, 0) + 1
+            data["trust_plane"] = {
+                "rounds_with_brb": len(brb_rounds),
+                "min_peers_delivered": min(r["brb_delivered"] for r in brb_rounds),
+                "mean_peers_delivered": (
+                    sum(r["brb_delivered"] for r in brb_rounds) / len(brb_rounds)
+                ),
+                "delivery_failures": {str(p): n for p, n in sorted(failed.items())},
+                "gated_trainers": {str(t): n for t, n in sorted(excluded.items())},
+                "control_messages": sum(
+                    r.get("control_messages") or 0 for r in brb_rounds
+                ),
+                "control_bytes": sum(r.get("control_bytes") or 0 for r in brb_rounds),
+            }
+        health = [r["protocol_health"] for r in rounds if r.get("protocol_health")]
+        if health:
+            margins = [
+                h["quorum_margin_min"]
+                for h in health
+                if h.get("quorum_margin_min") is not None
+            ]
+            p50s = [
+                (h.get("brb_latency_s") or {}).get("p50")
+                for h in health
+                if (h.get("brb_latency_s") or {}).get("p50") is not None
+            ]
+            p99s = [
+                (h.get("brb_latency_s") or {}).get("p99")
+                for h in health
+                if (h.get("brb_latency_s") or {}).get("p99") is not None
+            ]
+            data["protocol_health"] = {
+                "rounds_with_health": len(health),
+                "quorum_margin_min": min(margins) if margins else None,
+                "deliveries_total": sum(h.get("deliveries") or 0 for h in health),
+                "anomalies_total": sum(h.get("anomalies") or 0 for h in health),
+                "brb_latency_p50_worst_s": max(p50s) if p50s else None,
+                "brb_latency_p99_worst_s": max(p99s) if p99s else None,
+            }
+    if telemetry_snapshot:
+        data["telemetry"] = telemetry_snapshot
+    if flight_summary:
+        data["flight"] = flight_summary
+    return data
+
+
+def render_report(
+    records: list[dict],
+    telemetry_snapshot: dict | None = None,
+    flight_summary: dict | None = None,
+) -> str:
+    """Markdown digest of a metrics JSONL + optional telemetry snapshot
+    and flight-recorder dump.
+
+    Pure host-side rendering: no jax import, so ``report`` runs anywhere
+    the JSONL landed (a laptop, a CI artifact view) without a backend.
+    """
+    data = build_report_data(records, telemetry_snapshot, flight_summary)
+    lines = ["# p2pdl_tpu run report", ""]
+    rd = data.get("rounds")
+    if rd:
+        rows = [
+            ["rounds", _fmt(rd["count"])],
+            ["train loss (first -> last)",
+             f"{_fmt(rd['train_loss_first'])} -> {_fmt(rd['train_loss_last'])}"],
+            ["final eval acc", _fmt(rd["final_eval_acc"])],
+            ["best eval acc", _fmt(rd["best_eval_acc"])],
+            ["final eval loss", _fmt(rd["final_eval_loss"])],
+            ["total wall time (s)", _fmt(rd["total_wall_s"])],
+            ["first round (s, incl. compile)", _fmt(rd["first_round_s"])],
+            ["steady rounds/sec", _fmt(rd["steady_rounds_per_sec"])],
+        ]
+        lines += ["## Rounds", ""] + _md_table(["metric", "value"], rows) + [""]
+
+        tp = data.get("trust_plane")
+        if tp:
             rows = [
-                ["rounds with BRB", _fmt(len(brb_rounds))],
+                ["rounds with BRB", _fmt(tp["rounds_with_brb"])],
                 ["min / mean peers delivered",
-                 f"{min(r['brb_delivered'] for r in brb_rounds)} / "
-                 f"{_fmt(sum(r['brb_delivered'] for r in brb_rounds) / len(brb_rounds))}"],
+                 f"{tp['min_peers_delivered']} / {_fmt(tp['mean_peers_delivered'])}"],
                 ["peers with delivery failures (id: rounds)",
-                 ", ".join(f"{p}: {n}" for p, n in sorted(failed.items())) or "none"],
+                 ", ".join(f"{p}: {n}" for p, n in tp["delivery_failures"].items())
+                 or "none"],
                 ["trainers gated out (id: rounds)",
-                 ", ".join(f"{t}: {n}" for t, n in sorted(excluded.items())) or "none"],
-                ["control messages (total)",
-                 _fmt(sum(r.get("control_messages") or 0 for r in brb_rounds))],
-                ["control bytes (total)",
-                 _fmt(sum(r.get("control_bytes") or 0 for r in brb_rounds))],
+                 ", ".join(f"{t}: {n}" for t, n in tp["gated_trainers"].items())
+                 or "none"],
+                ["control messages (total)", _fmt(tp["control_messages"])],
+                ["control bytes (total)", _fmt(tp["control_bytes"])],
             ]
             lines += ["## Trust plane (BRB)", ""] + _md_table(["metric", "value"], rows) + [""]
+
+        ph = data.get("protocol_health")
+        if ph:
+            rows = [
+                ["rounds with health summary", _fmt(ph["rounds_with_health"])],
+                ["min quorum margin", _fmt(ph["quorum_margin_min"])],
+                ["deliveries (total)", _fmt(ph["deliveries_total"])],
+                ["recorder anomalies (total)", _fmt(ph["anomalies_total"])],
+                ["BRB latency p50 (s, worst round)",
+                 _fmt(ph["brb_latency_p50_worst_s"])],
+                ["BRB latency p99 (s, worst round)",
+                 _fmt(ph["brb_latency_p99_worst_s"])],
+            ]
+            lines += ["## Protocol health", ""] + _md_table(["metric", "value"], rows) + [""]
     else:
         lines += ["_No round records found._", ""]
+
+    fl = data.get("flight")
+    if fl:
+        rows = [
+            ["events", _fmt(fl.get("events"))],
+            ["event kinds",
+             ", ".join(f"{k}: {n}" for k, n in (fl.get("kinds") or {}).items())
+             or "none"],
+            ["anomalies", _fmt(fl.get("anomaly_count"))],
+            ["anomalies by kind",
+             ", ".join(
+                 f"{k}: {n}" for k, n in (fl.get("anomalies_by_kind") or {}).items()
+             ) or "none"],
+        ]
+        lines += ["## Flight recorder", ""] + _md_table(["metric", "value"], rows) + [""]
 
     if telemetry_snapshot:
         counters = telemetry_snapshot.get("counters") or {}
@@ -551,6 +678,17 @@ def render_report(records: list[dict], telemetry_snapshot: dict | None = None) -
     return "\n".join(lines).rstrip() + "\n"
 
 
+def _load_flight_events(path: str) -> list[dict]:
+    """Load a flight-recorder JSONL dump (one event object per line)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return events
+
+
 def run_report(args: argparse.Namespace) -> int:
     from p2pdl_tpu.utils.metrics import load_results
 
@@ -562,7 +700,57 @@ def run_report(args: argparse.Namespace) -> int:
     if args.telemetry_path:
         with open(args.telemetry_path) as f:
             snapshot = json.load(f)
-    sys.stdout.write(render_report(records, snapshot))
+    flight_summary = None
+    if args.flight_path:
+        flight_summary = flight_summary_from_events(
+            _load_flight_events(args.flight_path)
+        )
+    if args.lint_json:
+        # Machine-readable mirror of the Markdown digest: same numbers,
+        # same sections, one JSON object.
+        json.dump(
+            build_report_data(records, snapshot, flight_summary),
+            sys.stdout,
+            sort_keys=True,
+        )
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render_report(records, snapshot, flight_summary))
+    return 0
+
+
+def run_serve_metrics(args: argparse.Namespace) -> int:
+    """Standalone exposition server — jax-free: serves either the live
+    process registry or a recorded run (--telemetry-path / --flight-path)."""
+    from p2pdl_tpu.runtime.server import serve_metrics
+    from p2pdl_tpu.utils import flight, telemetry
+
+    snapshot_fn = telemetry.snapshot
+    if args.telemetry_path:
+        with open(args.telemetry_path) as f:
+            snap = json.load(f)
+        snapshot_fn = lambda: snap  # noqa: E731 -- frozen snapshot server
+    if args.flight_path:
+        flight.set_enabled(True)
+        rec = flight.recorder()
+        for ev in _load_flight_events(args.flight_path):
+            ev = dict(ev)
+            ev.pop("n", None)
+            ev.pop("ts", None)
+            kind = ev.pop("kind", "?")
+            if ev.pop("anomaly", False):
+                rec.anomaly(kind, **ev)
+            else:
+                rec.record(kind, **ev)
+    server = serve_metrics(port=args.port, snapshot_fn=snapshot_fn)
+    print(
+        json.dumps({"serving": True, "port": server.server_address[1]}),
+        flush=True,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
     return 0
 
 
@@ -571,6 +759,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.mode == "report":
         # Pure host path: no jax/backend init, just JSONL + JSON rendering.
         return run_report(args)
+    if args.mode == "serve-metrics":
+        # Pure host path: the exposition server never imports jax.
+        return run_serve_metrics(args)
     if args.mode == "lint":
         # Pure host path: p2plint is stdlib-ast only, no jax/backend init.
         from p2pdl_tpu.analysis import cli_lint
@@ -672,6 +863,10 @@ def main(argv: list[str] | None = None) -> int:
     fault_plan = args.fault_plan
     if args.mode == "chaos" and fault_plan is None:
         fault_plan = "crash_drop_partition"
+    if args.flight_path:
+        from p2pdl_tpu.utils import flight
+
+        flight.set_enabled(True)
     if fault_plan is not None and args.fused_rounds > 0:
         _warn("a fault plan requires per-round driving; ignoring --fused-rounds")
         args.fused_rounds = 0
@@ -694,6 +889,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.telemetry_path:
         with open(args.telemetry_path, "w") as f:
             json.dump(telemetry.snapshot(), f)
+    if args.flight_path:
+        from p2pdl_tpu.utils import flight
+
+        flight.dump(args.flight_path)
     if exp.faults is not None:
         print(json.dumps({
             "survival": exp.survival_summary(),

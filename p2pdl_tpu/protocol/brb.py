@@ -33,7 +33,7 @@ import time
 from typing import Optional
 
 from p2pdl_tpu.protocol import crypto
-from p2pdl_tpu.utils import telemetry
+from p2pdl_tpu.utils import flight, telemetry
 
 SEND, ECHO, READY = "send", "echo", "ready"
 
@@ -160,6 +160,8 @@ class BRBInstance:
         key_server,
         private_key,
         sign_control: bool = True,
+        sender: Optional[int] = None,
+        seq: Optional[int] = None,
     ) -> None:
         self.cfg = cfg
         self.my_id = my_id
@@ -171,6 +173,10 @@ class BRBInstance:
         # SENDs always carry their own signature: the payload travels once,
         # per message, in both framings.
         self.sign_control = sign_control
+        # Instance identity for the flight recorder's per-instance timelines
+        # (None when constructed outside a Broadcaster, e.g. unit tests).
+        self.sender = sender
+        self.seq = seq
         self.payloads: dict[bytes, bytes] = {}
         self.accepted_digest: Optional[bytes] = None  # first valid SEND wins the echo
         self.echoes: dict[bytes, set[int]] = {}
@@ -182,9 +188,16 @@ class BRBInstance:
         self.sent_echo = False
         self.sent_ready = False
         self.delivered: Optional[bytes] = None
+        self.delivered_digest: Optional[bytes] = None
+        self.delivery_latency_s: Optional[float] = None
         # perf_counter stamp of this peer's own ECHO emission — start of the
         # echo->deliver latency observation (None until the echo goes out).
         self._echo_at: Optional[float] = None
+
+    def _flight(self, kind: str, **fields) -> None:
+        flight.record(
+            kind, sender=self.sender, seq=self.seq, peer=self.my_id, **fields
+        )
 
     def _make(self, kind: str, sender: int, seq: int, digest: bytes, payload=None) -> BRBMessage:
         telemetry.counter("brb.messages", kind=kind, dir="tx").inc()
@@ -209,11 +222,19 @@ class BRBInstance:
                 # the quorum voted for (payloads dict only admits verified
                 # sha256 matches).
                 self.delivered = self.payloads[digest]
+                self.delivered_digest = digest
                 telemetry.counter("brb.delivered").inc()
                 if self._echo_at is not None:
+                    self.delivery_latency_s = time.perf_counter() - self._echo_at
                     telemetry.histogram("brb.echo_to_deliver_seconds").observe(
-                        time.perf_counter() - self._echo_at
+                        self.delivery_latency_s
                     )
+                self._flight(
+                    "brb_deliver",
+                    votes=len(voters),
+                    quorum=self.cfg.deliver_quorum,
+                    margin=len(voters) - self.cfg.deliver_quorum,
+                )
                 return
 
     def handle(self, msg: BRBMessage) -> list[BRBMessage]:
@@ -250,6 +271,7 @@ class BRBInstance:
             if self.accepted_digest == msg.digest and not self.sent_echo:
                 self.sent_echo = True
                 self._echo_at = time.perf_counter()
+                self._flight("brb_echo", digest=msg.digest.hex()[:12])
                 out.append(self._make(ECHO, msg.sender, msg.seq, msg.digest))
             # A late SEND can complete a delivery whose READY quorum for this
             # digest already formed (payload was the missing piece).
@@ -263,6 +285,12 @@ class BRBInstance:
             voters.add(msg.from_id)
             if len(voters) >= self.cfg.echo_quorum and not self.sent_ready:
                 self.sent_ready = True
+                self._flight(
+                    "brb_ready",
+                    via="echo",
+                    votes=len(voters),
+                    quorum=self.cfg.echo_quorum,
+                )
                 out.append(self._make(READY, msg.sender, msg.seq, msg.digest))
 
         elif msg.kind == READY:
@@ -273,6 +301,12 @@ class BRBInstance:
             voters.add(msg.from_id)
             if len(voters) >= self.cfg.ready_amplify and not self.sent_ready:
                 self.sent_ready = True
+                self._flight(
+                    "brb_ready",
+                    via="amplify",
+                    votes=len(voters),
+                    quorum=self.cfg.ready_amplify,
+                )
                 out.append(self._make(READY, msg.sender, msg.seq, msg.digest))
             self._try_deliver()
 
@@ -334,6 +368,16 @@ class Broadcaster:
                 self.key_server,
                 self.private_key,
                 sign_control=self.sign_control,
+                sender=sender,
+                seq=seq,
+            )
+            flight.record(
+                "brb_init",
+                sender=sender,
+                seq=seq,
+                peer=self.my_id,
+                n=self.cfg.n,
+                f=self.cfg.f,
             )
         return self.instances[key]
 
@@ -389,6 +433,14 @@ class Broadcaster:
         for sender, digest in batch.items:
             if len(digest) != DIGEST_LEN or not self.key_server.has_key(int(sender)):
                 telemetry.counter("brb.batch_rejected", reason="malformed_item").inc()
+                flight.anomaly(
+                    "batch_rejected",
+                    round=batch.seq,
+                    seq=batch.seq,
+                    from_id=batch.from_id,
+                    peer=self.my_id,
+                    reason="malformed_item",
+                )
                 return []
         if not batch_ok(self.key_server, batch):
             telemetry.counter("brb.signature_failures", kind="batch").inc()
@@ -403,12 +455,32 @@ class Broadcaster:
         inst = self.instances.get((sender, seq))
         return inst.delivered if inst else None
 
-    def prune(self, before_seq: int) -> None:
+    def prune(self, before_seq: int, report_timeouts: bool = False) -> None:
         """Evict instances of completed rounds (seq < before_seq) — without
         this a long experiment leaks one instance per (sender, round).
         An evicted instance that never delivered is a timed-out broadcast
-        (its round's deadline passed), counted as ``brb.instances{...}``."""
+        (its round's deadline passed), counted as ``brb.instances{...}``.
+
+        ``report_timeouts=True`` additionally raises a flight-recorder
+        ``brb_timeout`` anomaly per undelivered instance — the trust plane
+        enables it on committee broadcasters, where non-delivery is a real
+        protocol failure (a trainer's own never-completed SEND instance on a
+        non-committee peer is expected, not anomalous)."""
         for key in [k for k in self.instances if k[1] < before_seq]:
-            outcome = "delivered" if self.instances[key].delivered is not None else "timed_out"
+            inst = self.instances[key]
+            outcome = "delivered" if inst.delivered is not None else "timed_out"
             telemetry.counter("brb.instances", outcome=outcome).inc()
+            if report_timeouts and inst.delivered is None:
+                ready_votes = max(
+                    [len(v) for v in inst.readies.values()], default=0
+                )
+                flight.anomaly(
+                    "brb_timeout",
+                    round=key[1],
+                    sender=key[0],
+                    seq=key[1],
+                    peer=self.my_id,
+                    ready_votes=ready_votes,
+                    quorum=inst.cfg.deliver_quorum,
+                )
             del self.instances[key]

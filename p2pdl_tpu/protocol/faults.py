@@ -41,7 +41,7 @@ import json
 import os
 from typing import Optional
 
-from p2pdl_tpu.utils import telemetry
+from p2pdl_tpu.utils import flight, telemetry
 
 
 @dataclasses.dataclass(frozen=True)
@@ -319,6 +319,7 @@ class FailureDetector:
                 if p in self.suspected:
                     self.suspected.discard(p)
                     recovered.append(p)
+                    flight.record("unsuspect", round=round_idx, peer=p)
             else:
                 self.misses[p] += 1
                 if (
@@ -327,6 +328,9 @@ class FailureDetector:
                 ):
                     self.suspected.add(p)
                     newly.append(p)
+                    flight.record(
+                        "suspect", round=round_idx, peer=p, misses=self.misses[p]
+                    )
         return newly, recovered
 
     def live(self) -> list[int]:
@@ -410,6 +414,8 @@ class FaultInjector:
             if part.at_round <= round_idx < part.heal_round:
                 active = part.groups
         self.partition = active
+        for ev in events:
+            flight.record("fault", round=round_idx, **ev)
         return events
 
     def apply_round(self, hub) -> None:

@@ -29,6 +29,7 @@ from typing import Any, Optional
 
 from p2pdl_tpu.config import Config
 from p2pdl_tpu.runtime.driver import Experiment, RoundRecord
+from p2pdl_tpu.utils import flight
 
 
 class Node:
@@ -51,6 +52,7 @@ class Node:
         """(Re-)join the cluster: eligible for sampling and consent again
         (reference ``start()`` binds the listener socket)."""
         self.cluster._stopped.discard(self.node_id)
+        flight.record("membership", peer=self.node_id, change="start")
 
     def stop(self) -> None:
         """Go dark, like the reference's socket teardown (``node/node.py:
@@ -58,6 +60,7 @@ class Node:
         sampled it runs with its slot vacated (-1, shrunken participation),
         and its delivery flag never sets. ``start()`` re-admits."""
         self.cluster._stopped.add(self.node_id)
+        flight.record("membership", peer=self.node_id, change="stop")
 
     def connect(self, other: "Node") -> None:
         """Record a neighbor (reference ``node/node.py:251-263``; its TCP

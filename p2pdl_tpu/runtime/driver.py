@@ -53,7 +53,7 @@ from p2pdl_tpu.protocol.transport import (
     brb_to_wire,
     control_from_wire,
 )
-from p2pdl_tpu.utils import telemetry
+from p2pdl_tpu.utils import flight, telemetry
 from p2pdl_tpu.utils.metrics import MetricsLogger
 from p2pdl_tpu.utils.profiling import Profiler
 
@@ -97,16 +97,43 @@ class RoundRecord:
     # dp_noise_multiplier > 0): utils/dp.rdp_epsilon over round+1 releases.
     dp_epsilon: Optional[float] = None
     # Chaos plane (None unless a FaultPlan is active). All deterministic —
-    # duration_s stays the only wall-clock field, so a same-seed rerun's
-    # record stream is bit-identical once duration_s is stripped.
+    # duration_s and protocol_health["brb_latency_s"] are the only wall-clock
+    # fields, so a same-seed rerun's record stream is bit-identical once
+    # those two are stripped.
     fault_events: Optional[list[dict]] = None  # crash/recover/partition/heal/suspect
     suspected_peers: Optional[list[int]] = None  # failure detector's view this round
     excluded_peers: Optional[list[int]] = None  # ineligible for sampling this round
     faults_injected: Optional[dict[str, int]] = None  # per-round message-fault counts
     mask_recoveries: Optional[list[int]] = None  # peers whose seeds Shamir-recovered
+    # Per-round protocol health (None when the trust plane is off): quorum
+    # sizes/margins and the flight recorder's anomaly delta are deterministic;
+    # the nested "brb_latency_s" block is wall-clock quantiles and sits
+    # outside the bit-identity contract alongside duration_s.
+    protocol_health: Optional[dict[str, Any]] = None
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
+
+
+def _latency_block(latencies: list[float]) -> dict[str, Any]:
+    """Exact order-statistic quantiles over one round's BRB delivery
+    latencies (a handful of host floats — no need for the registry's
+    bucketed estimates). Wall-clock: excluded from the bit-identity
+    contract like ``duration_s``."""
+    lats = sorted(latencies)
+    if not lats:
+        return {"count": 0}
+
+    def q(f: float) -> float:
+        return lats[min(len(lats) - 1, int(f * len(lats)))]
+
+    return {
+        "count": len(lats),
+        "p50": q(0.50),
+        "p90": q(0.90),
+        "p99": q(0.99),
+        "max": lats[-1],
+    }
 
 
 class _TrustPlane:
@@ -142,6 +169,9 @@ class _TrustPlane:
         self.byz_ids = set(byz_ids)
         self.lie_digests: dict[int, bytes] = {}
         self.broadcasters: list[Broadcaster] = []
+        # Latest run_round()'s quorum/latency digest (see the assignment
+        # there for the schema); None until the first round runs.
+        self.last_round_health: Optional[dict[str, Any]] = None
         # Coalesced control frames (wire v2, cfg.control_batching): handler
         # outputs accumulate per emitting peer per (kind, seq) and flush as
         # ONE signed batch frame per (src, dst) pair per phase instead of
@@ -277,7 +307,28 @@ class _TrustPlane:
         live = [p for p in self.committee if p not in dark]
         if dark and len(live) > 3 * self.cfg.byzantine_f:
             live_cfg = BRBConfig(len(live), self.cfg.byzantine_f)
+            if len(live) < len(self.committee):
+                flight.record(
+                    "quorum_reconfig",
+                    round=round_idx,
+                    live=len(live),
+                    committee=len(self.committee),
+                    f=self.cfg.byzantine_f,
+                    suspected=sorted(dark),
+                )
         else:
+            if dark:
+                # Suspicion shrank the committee past n > 3f: quorums cannot
+                # recompute safely, so the full config is kept and the round
+                # is allowed to fail loudly — a health anomaly by definition.
+                flight.anomaly(
+                    "quorum_collapse",
+                    round=round_idx,
+                    live=len(live),
+                    committee=len(self.committee),
+                    f=self.cfg.byzantine_f,
+                    suspected=sorted(dark),
+                )
             live = list(self.committee)
             live_cfg = BRBConfig(len(self.committee), self.cfg.byzantine_f)
         self._live_committee = live
@@ -346,8 +397,34 @@ class _TrustPlane:
                 for pid in live_peers
             ):
                 verified.append(tid)
-        for bc in self.broadcasters:
-            bc.prune(round_idx)
+        # Per-instance quorum margins and delivery latencies for the round's
+        # health summary: margin = ready votes beyond the delivery quorum on
+        # the digest that actually delivered (0 = delivered with zero slack).
+        margins: list[int] = []
+        latencies: list[float] = []
+        for pid in live_peers:
+            for tid in trainer_ids:
+                inst = self.broadcasters[pid].instances.get((tid, round_idx))
+                if inst is None or inst.delivered_digest is None:
+                    continue
+                margins.append(
+                    len(inst.readies[inst.delivered_digest])
+                    - inst.cfg.deliver_quorum
+                )
+                if inst.delivery_latency_s is not None:
+                    latencies.append(inst.delivery_latency_s)
+        self.last_round_health = {
+            "live_committee": len(live),
+            "deliver_quorum": live_cfg.deliver_quorum,
+            "quorum_margin_min": min(margins) if margins else None,
+            "deliveries": len(margins),
+            "latencies": latencies,  # wall-clock; quantiled by the driver
+        }
+        for pid, bc in enumerate(self.broadcasters):
+            # Committee members report undelivered instances as brb_timeout
+            # anomalies; a non-committee trainer's own SEND instance never
+            # completes by design and must not count as one.
+            bc.prune(round_idx, report_timeouts=pid in live)
         return len(live) - len(failed), failed, verified
 
 
@@ -603,6 +680,7 @@ class Experiment:
         # p2plint: disable=hostsync-transfer -- THE audited single device->host transfer per round (driver.d2h_transfers)
         buf = np.asarray(jax.device_get(packed))  # the round's one D2H
         telemetry.counter("driver.d2h_transfers").inc()
+        flight.record("d2h", round=r, nbytes=int(buf.nbytes))
         pool = _digest_pool()
         futures = {
             int(t): pool.submit(hash_row, buf[i])
@@ -618,11 +696,18 @@ class Experiment:
         msgs = self.trust.hub.messages_sent - m0
         nbytes = self.trust.hub.bytes_sent - b0
         telemetry.gauge("driver.live_peers").set(delivered)
+        health = self.trust.last_round_health
+        if health is not None and health["quorum_margin_min"] is not None:
+            telemetry.gauge("driver.quorum_margin_min").set(
+                health["quorum_margin_min"]
+            )
         # Per-peer failure counters: a peer that keeps missing deliveries
         # across rounds shows up as a hot series, not a scalar average.
         for pid in failed:
+            # p2plint: disable=telemetry-cardinality -- deliberate per-peer failure series, O(num_peers) and folded past the registry cap
             telemetry.counter("driver.brb_delivery_failures", peer=pid).inc()
         for tid in excluded:
+            # p2plint: disable=telemetry-cardinality -- deliberate per-trainer exclusion series, O(num_peers) and folded past the registry cap
             telemetry.counter("driver.brb_excluded_trainers", trainer=tid).inc()
         if self.failure_cooldown_rounds > 0:
             for pid in failed + excluded:
@@ -672,6 +757,9 @@ class Experiment:
                 )
             except ValueError:
                 telemetry.counter("chaos.mask_recovery", outcome="failed").inc()
+                flight.record(
+                    "mask_recovery", round=r, peer=tid, outcome="failed"
+                )
                 continue
             wiped = self._seed_mat.copy()
             wiped[tid, :, :] = 0
@@ -684,8 +772,14 @@ class Experiment:
             if np.array_equal(patched[tid][used], self._seed_mat[tid][used]):
                 recovered.append(tid)
                 telemetry.counter("chaos.mask_recovery", outcome="recovered").inc()
+                flight.record(
+                    "mask_recovery", round=r, peer=tid, outcome="recovered"
+                )
             else:
                 telemetry.counter("chaos.mask_recovery", outcome="mismatch").inc()
+                flight.record(
+                    "mask_recovery", round=r, peer=tid, outcome="mismatch"
+                )
         return recovered
 
     def run_round(self, trainers: Optional[np.ndarray] = None) -> RoundRecord:
@@ -710,6 +804,12 @@ class Experiment:
         # the losses the synchronous loop would have seen.
         self._flush_pending_round()
         r = self._round_cursor
+        # Anomaly watermark: everything the flight recorder counts between
+        # here and this round's pending-record build belongs to round r
+        # (timeouts of round r-1's instances surface during round r's prune
+        # and are attributed here — one round late, like the readbacks).
+        anoms0 = flight.recorder().anomaly_count
+        telemetry.gauge("driver.round_index").set(r)
         fault_events = suspected_now = excluded_now = None
         if self.faults is not None:
             fault_events = self.faults.begin_round(r)
@@ -727,9 +827,11 @@ class Experiment:
             }
             newly, recovered = self.detector.observe(r, responded)
             for p in newly:
+                # p2plint: disable=telemetry-cardinality -- deliberate per-peer suspicion series, O(num_peers) and folded past the registry cap
                 telemetry.counter("chaos.suspected", peer=p).inc()
                 fault_events.append({"event": "suspected", "peer": p})
             for p in recovered:
+                # p2plint: disable=telemetry-cardinality -- deliberate per-peer suspicion series, O(num_peers) and folded past the registry cap
                 telemetry.counter("chaos.unsuspected", peer=p).inc()
                 fault_events.append({"event": "unsuspected", "peer": p})
             suspected_now = sorted(self.detector.suspected)
@@ -761,6 +863,13 @@ class Experiment:
         # sample_roles); the device program consumes the padded vector, the
         # host plane (trust, metrics, records) only the live peers.
         live = trainers[trainers >= 0]
+        telemetry.gauge("driver.suspected_peers").set(len(self.detector.suspected))
+        flight.record(
+            "round_begin",
+            round=r,
+            trainers=[int(t) for t in live],
+            suspected=sorted(self.detector.suspected),
+        )
         mask_key = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), r)
         t0 = time.perf_counter()
         brb_delivered = brb_failed = brb_excluded = msgs = nbytes = None
@@ -927,6 +1036,21 @@ class Experiment:
             # would stall the host on the whole round's device chain, so the
             # float() readbacks happen at flush time, one round late.
             ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)
+        # Per-round protocol health: deterministic quorum facts plus the
+        # flight recorder's anomaly delta (unconditional counting, so the
+        # record is identical with the recorder on or off), plus wall-clock
+        # latency quantiles in their own stripped-for-replay block.
+        protocol_health = None
+        if brb_delivered is not None and self.trust is not None:
+            h = self.trust.last_round_health or {}
+            protocol_health = {
+                "live_committee": h.get("live_committee"),
+                "deliver_quorum": h.get("deliver_quorum"),
+                "quorum_margin_min": h.get("quorum_margin_min"),
+                "deliveries": h.get("deliveries"),
+                "anomalies": flight.recorder().anomaly_count - anoms0,
+                "brb_latency_s": _latency_block(h.get("latencies") or []),
+            }
         # duration_s is measured at the dispatch/defer point (and is the one
         # field excluded from the bit-identity contract, see RoundRecord).
         self._pending_round = {
@@ -950,6 +1074,7 @@ class Experiment:
                 dict(self.faults.round_injected) if self.faults is not None else None
             ),
             "mask_recoveries": mask_recoveries,
+            "health": protocol_health,
         }
         self._round_cursor = r + 1
         boundary = (
@@ -997,7 +1122,9 @@ class Experiment:
             excluded_peers=p["excluded_now"],
             faults_injected=p["faults_injected"],
             mask_recoveries=p["mask_recoveries"],
+            protocol_health=p["health"],
         )
+        flight.record("pipeline_flush", round=p["r"])
         # Compile/steady split: this PROCESS's first round pays jit tracing
         # + XLA compilation (whatever round index a resumed run starts at);
         # every later round is steady-state. Splitting the series keeps the
@@ -1007,6 +1134,8 @@ class Experiment:
             telemetry.gauge("driver.first_round_s").set(record.duration_s)
         else:
             telemetry.histogram("driver.steady_round_s").observe(record.duration_s)
+        if record.duration_s > 0:
+            telemetry.gauge("driver.rounds_per_sec").set(1.0 / record.duration_s)
         self.records.append(record)
         self.metrics.log(record.to_dict())
         return record

@@ -1,4 +1,4 @@
-"""HTTP orchestration facade.
+"""HTTP orchestration facade + live telemetry exposition.
 
 API parity with the reference's Flask app (reference ``main.py``):
 ``POST /start_training`` runs the configured number of rounds and returns
@@ -7,6 +7,21 @@ the per-round learning progress JSON (reference ``main.py:45-109``);
 Built on ``http.server`` (stdlib) so the framework adds no web-framework
 dependency; single worker thread — the driver is intentionally
 single-threaded (SURVEY §5 race-detection note).
+
+Observability plane (shared between the orchestrator and the standalone
+``cli serve-metrics`` server):
+
+- ``GET /metrics``  — Prometheus text exposition 0.0.4 over the live
+  registry (``telemetry.render_prometheus``), scrapeable mid-run: the
+  registry's own lock snapshots the series while the driver keeps writing.
+- ``GET /healthz``  — JSON liveness: flight-recorder anomaly totals plus
+  (on the orchestrator) training state.
+- ``GET /flight``   — the flight recorder's summary and time-stripped
+  event ring as JSON (the debugging surface for a run in flight).
+
+Every handler replies with a JSON body and a correct status code: unknown
+paths are 404, malformed POST bodies 400, a busy trainer 409, and an
+internal failure 500 — never a bare connection reset.
 """
 
 from __future__ import annotations
@@ -14,26 +29,34 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
 
 from p2pdl_tpu.config import Config
-from p2pdl_tpu.runtime.cluster import Cluster
+from p2pdl_tpu.utils import flight, telemetry
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class OrchestratorState:
     def __init__(self, cfg: Config, **experiment_kwargs) -> None:
+        # Lazy import: Cluster pulls in the jax-backed driver, which the
+        # jax-free exposition path (serve_metrics) must never pay for.
+        from p2pdl_tpu.runtime.cluster import Cluster
+
         self.cfg = cfg
         self.cluster = Cluster(cfg, **experiment_kwargs)
         self.lock = threading.Lock()
         self.training = False
 
-    def start_training(self) -> dict:
-        """Run ``cfg.rounds`` rounds; returns learning progress per round
-        (reference ``main.py:96-109`` shape: per-TESTER ``{accuracy, addr,
-        port}`` entries under ``results``, each tester's accuracy measured
-        on its own shard, plus our held-out global metrics)."""
+    def start_training(self) -> tuple[int, dict]:
+        """Run ``cfg.rounds`` rounds; returns ``(status_code, payload)``
+        with learning progress per round (reference ``main.py:96-109``
+        shape: per-TESTER ``{accuracy, addr, port}`` entries under
+        ``results``, each tester's accuracy measured on its own shard, plus
+        our held-out global metrics)."""
         with self.lock:
             if self.training:
-                return {"error": "training already in progress"}
+                return 409, {"error": "training already in progress"}
             self.training = True
         try:
             progress = []
@@ -54,26 +77,111 @@ class OrchestratorState:
                         "results": self.cluster.per_node_results(testers),
                         "duration_s": record.duration_s,
                         "brb_delivered": record.brb_delivered,
+                        "protocol_health": record.protocol_health,
                     }
                 )
-            return {"status": "completed", "learning_progress": progress}
+            return 200, {"status": "completed", "learning_progress": progress}
         finally:
             with self.lock:
                 self.training = False
 
 
-def make_handler(state: OrchestratorState):
-    class Handler(BaseHTTPRequestHandler):
-        def _reply(self, code: int, payload: dict) -> None:
-            body = json.dumps(payload).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+def _observability_get(
+    path: str,
+    snapshot_fn: Callable[[], dict],
+    extra_health: Optional[Callable[[], dict]] = None,
+) -> Optional[tuple[int, str, bytes]]:
+    """Route the shared observability GETs; returns ``(status, content_type,
+    body)`` or None when ``path`` is not an observability endpoint."""
+    if path == "/metrics":
+        body = telemetry.render_prometheus(snapshot_fn()).encode()
+        return 200, PROMETHEUS_CONTENT_TYPE, body
+    if path == "/healthz":
+        rec = flight.recorder()
+        payload: dict[str, Any] = {
+            "status": "ok",
+            "anomaly_count": rec.anomaly_count,
+            "anomalies_by_kind": dict(sorted(rec.anomalies_by_kind.items())),
+        }
+        if extra_health is not None:
+            payload.update(extra_health())
+        return 200, "application/json", json.dumps(payload).encode()
+    if path == "/flight":
+        rec = flight.recorder()
+        payload = {
+            "summary": rec.summary(),
+            "events": rec.events(strip_time=True),
+        }
+        return 200, "application/json", json.dumps(payload).encode()
+    return None
 
+
+class _JSONHandler(BaseHTTPRequestHandler):
+    """Base handler: JSON replies, JSON errors, no connection-killing
+    exceptions (a handler bug answers 500, it does not reset the socket)."""
+
+    def _send(self, code: int, content_type: str, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply(self, code: int, payload: dict) -> None:
+        self._send(code, "application/json", json.dumps(payload).encode())
+
+    def _guarded(self, fn) -> None:
+        try:
+            fn()
+        except BrokenPipeError:
+            pass  # client went away mid-reply; nothing to answer
+        except Exception as e:  # noqa: BLE001 -- the 500 body IS the report
+            try:
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
+
+    def _read_json_body(self) -> tuple[Optional[dict], Optional[str]]:
+        """Parse an optional JSON POST body; ``(None, error)`` on garbage."""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            return None, "malformed Content-Length"
+        if length == 0:
+            return {}, None
+        raw = self.rfile.read(length)
+        try:
+            doc = json.loads(raw)
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            return None, f"malformed JSON body: {e}"
+        if not isinstance(doc, dict):
+            return None, "JSON body must be an object"
+        return doc, None
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+
+def make_handler(state: OrchestratorState):
+    class Handler(_JSONHandler):
         def do_GET(self) -> None:
-            if self.path == "/status":
+            self._guarded(self._get)
+
+        def _get(self) -> None:
+            def extra_health() -> dict:
+                with state.lock:
+                    training = state.training
+                return {
+                    "status": "training" if training else "idle",
+                    "rounds_completed": len(state.cluster.experiment.records),
+                }
+
+            routed = _observability_get(
+                self.path, telemetry.snapshot, extra_health
+            )
+            if routed is not None:
+                self._send(*routed)
+            elif self.path == "/status":
                 with state.lock:
                     training = state.training
                 rounds_done = len(state.cluster.experiment.records)
@@ -86,16 +194,20 @@ def make_handler(state: OrchestratorState):
                     },
                 )
             else:
-                self._reply(404, {"error": "not found"})
+                self._reply(404, {"error": f"not found: {self.path}"})
 
         def do_POST(self) -> None:
-            if self.path == "/start_training":
-                self._reply(200, state.start_training())
-            else:
-                self._reply(404, {"error": "not found"})
+            self._guarded(self._post)
 
-        def log_message(self, *args) -> None:  # quiet
-            pass
+        def _post(self) -> None:
+            if self.path == "/start_training":
+                _, err = self._read_json_body()
+                if err is not None:
+                    self._reply(400, {"error": err})
+                    return
+                self._reply(*state.start_training())
+            else:
+                self._reply(404, {"error": f"not found: {self.path}"})
 
     return Handler
 
@@ -108,4 +220,33 @@ def serve(
     state = OrchestratorState(cfg, **experiment_kwargs)
     server = ThreadingHTTPServer((host, port), make_handler(state))
     server.orchestrator = state  # type: ignore[attr-defined]
+    return server
+
+
+def serve_metrics(
+    host: str = "127.0.0.1",
+    port: int = 9090,
+    snapshot_fn: Optional[Callable[[], dict]] = None,
+) -> ThreadingHTTPServer:
+    """Standalone exposition server: ``/metrics`` + ``/healthz`` +
+    ``/flight`` with no orchestrator (and no jax import) attached.
+
+    ``snapshot_fn`` defaults to the live process registry; ``cli
+    serve-metrics --telemetry-path`` passes a loader over a snapshot JSON on
+    disk instead, turning any recorded run into a scrape target."""
+    if snapshot_fn is None:
+        snapshot_fn = telemetry.snapshot
+
+    class Handler(_JSONHandler):
+        def do_GET(self) -> None:
+            self._guarded(self._get)
+
+        def _get(self) -> None:
+            routed = _observability_get(self.path, snapshot_fn)
+            if routed is not None:
+                self._send(*routed)
+            else:
+                self._reply(404, {"error": f"not found: {self.path}"})
+
+    server = ThreadingHTTPServer((host, port), Handler)
     return server

@@ -1,0 +1,271 @@
+"""Protocol flight recorder: a bounded, replay-exact structured event log.
+
+The hardest protocol bugs are *causal* — a BRB instance that never delivers,
+a quorum that silently shrinks, a mask recovery that fires one round late —
+and aggregate counters cannot answer "what happened to instance (3, 17)?".
+This module records the protocol's state transitions as structured events in
+a fixed-size ring buffer:
+
+- BRB instance lifecycle (``brb_init → brb_echo → brb_ready →
+  brb_deliver | brb_timeout``) with vote counts and quorum margins,
+- failure-detector suspicion flips and live-quorum reconfigurations,
+- fault injections, Shamir mask recoveries, cluster membership changes,
+- pipeline flush / device-readback boundaries in the driver.
+
+Determinism contract (the property the chaos tests pin): every event field
+except ``ts`` is derived from seeded protocol state, so two runs with the
+same seed and FaultPlan produce bit-identical ``events(strip_time=True)``
+streams. ``ts`` is ``time.perf_counter()`` — the sanctioned monotonic clock
+— and is stripped for comparisons, exactly like ``RoundRecord.duration_s``.
+
+Cost model: recording is OFF by default (``P2PDL_FLIGHT=1`` or
+``set_enabled(True)`` opts in); while off, ``record()`` is one predicate
+check. ``anomaly()`` additionally maintains *unconditional* anomaly
+counters — cheap int adds on deterministic inputs — so the per-round health
+summary attached to ``RoundRecord`` is identical whether or not event
+storage is enabled (the recorder-on/off bit-identity contract).
+
+Anomalies (delivery timeout, ``batch_rejected``, live-quorum collapse)
+trigger an automatic JSONL dump of the ring when ``P2PDL_FLIGHT_DIR`` is
+set, throttled to one dump per (kind, round) so a noisy round cannot spam
+the disk.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+__all__ = [
+    "FlightRecorder",
+    "DEFAULT_CAPACITY",
+    "ANOMALY_KINDS",
+    "recorder",
+    "record",
+    "anomaly",
+    "enabled",
+    "set_enabled",
+    "reset",
+    "dump",
+]
+
+DEFAULT_CAPACITY = 4096
+
+# The anomaly kinds that trigger dump-on-anomaly. Everything here is a
+# protocol-health violation, not a routine transition.
+ANOMALY_KINDS = ("brb_timeout", "batch_rejected", "quorum_collapse")
+
+
+class FlightRecorder:
+    """Bounded structured event log with anomaly accounting.
+
+    Events are plain dicts ``{"n": seq, "kind": ..., "ts": ..., **fields}``
+    where ``n`` is a monotonically increasing sequence number (survives ring
+    eviction, so gaps reveal how much history was dropped) and all caller
+    fields are JSON-ready scalars.
+    """
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        enabled: Optional[bool] = None,
+        dump_dir: Optional[str] = None,
+    ) -> None:
+        if enabled is None:
+            enabled = os.environ.get("P2PDL_FLIGHT", "0") not in (
+                "0",
+                "off",
+                "false",
+                "",
+            )
+        if dump_dir is None:
+            dump_dir = os.environ.get("P2PDL_FLIGHT_DIR") or None
+        self.enabled = enabled
+        self.capacity = capacity
+        self.dump_dir = dump_dir
+        self._lock = threading.Lock()
+        self._ring: deque[dict[str, Any]] = deque(maxlen=capacity)
+        self._seq = 0
+        # Anomaly accounting is unconditional (see module docstring): these
+        # stay correct — and deterministic — with event storage disabled.
+        self.anomaly_count = 0
+        self.anomalies_by_kind: dict[str, int] = {}
+        self._dumped: set[tuple[str, Any]] = set()
+
+    # ---- recording ----------------------------------------------------------
+
+    def record(self, kind: str, **fields: Any) -> None:
+        """Append one event; a no-op (single predicate check) while disabled."""
+        if not self.enabled:
+            return
+        with self._lock:
+            ev = {"n": self._seq, "kind": kind, "ts": time.perf_counter()}
+            ev.update(fields)
+            self._seq += 1
+            self._ring.append(ev)
+
+    def anomaly(self, kind: str, **fields: Any) -> None:
+        """Record a protocol-health violation.
+
+        Counting is unconditional; event storage and dump-on-anomaly honor
+        ``self.enabled`` like every other event.
+        """
+        with self._lock:
+            self.anomaly_count += 1
+            self.anomalies_by_kind[kind] = self.anomalies_by_kind.get(kind, 0) + 1
+        self.record(kind, anomaly=True, **fields)
+        if self.enabled and self.dump_dir:
+            self._maybe_dump(kind, fields.get("round"))
+
+    def _maybe_dump(self, kind: str, round_idx: Any) -> None:
+        key = (kind, round_idx)
+        with self._lock:
+            if key in self._dumped:
+                return
+            self._dumped.add(key)
+        tag = "r%s" % round_idx if round_idx is not None else "r_"
+        path = os.path.join(self.dump_dir, f"flight_{kind}_{tag}.jsonl")
+        try:
+            self.dump_jsonl(path)
+        except OSError:
+            pass  # a broken dump dir must never take down the protocol
+
+    # ---- reading ------------------------------------------------------------
+
+    def events(self, strip_time: bool = False) -> list[dict[str, Any]]:
+        """Copy of the ring, oldest first. ``strip_time=True`` removes the
+        wall-clock ``ts`` field — the replay-comparison form."""
+        with self._lock:
+            evs = [dict(ev) for ev in self._ring]
+        if strip_time:
+            for ev in evs:
+                ev.pop("ts", None)
+        return evs
+
+    def instance_timelines(self) -> dict[str, list[dict[str, Any]]]:
+        """Per-BRB-instance event timelines keyed ``"sender:seq"``.
+
+        Reconstructs each instance's ``init → echo quorum → ready →
+        deliver/timeout`` history from the ``brb_*`` events still in the
+        ring, in arrival order.
+        """
+        timelines: dict[str, list[dict[str, Any]]] = {}
+        for ev in self.events():
+            if not ev["kind"].startswith("brb_"):
+                continue
+            sender, seq = ev.get("sender"), ev.get("seq")
+            if sender is None or seq is None:
+                continue
+            timelines.setdefault(f"{sender}:{seq}", []).append(ev)
+        return timelines
+
+    def instance_timeline(self, sender: int, seq: int) -> list[dict[str, Any]]:
+        return self.instance_timelines().get(f"{sender}:{seq}", [])
+
+    def summary(self) -> dict[str, Any]:
+        """JSON-ready digest: event volume, kind mix, anomaly accounting."""
+        with self._lock:
+            kinds: dict[str, int] = {}
+            for ev in self._ring:
+                kinds[ev["kind"]] = kinds.get(ev["kind"], 0) + 1
+            return {
+                "enabled": self.enabled,
+                "capacity": self.capacity,
+                "events_recorded": self._seq,
+                "events_retained": len(self._ring),
+                "kinds": dict(sorted(kinds.items())),
+                "anomaly_count": self.anomaly_count,
+                "anomalies_by_kind": dict(sorted(self.anomalies_by_kind.items())),
+            }
+
+    def determinism_digest(self) -> str:
+        """SHA-256 over the time-stripped event stream — two replay-identical
+        runs produce the same digest (the cheap bit-identity check)."""
+        h = hashlib.sha256()
+        for ev in self.events(strip_time=True):
+            h.update(json.dumps(ev, sort_keys=True).encode())
+        return h.hexdigest()
+
+    # ---- export -------------------------------------------------------------
+
+    def dump_jsonl(self, path: str) -> int:
+        """Atomically write the ring as JSONL (one event per line, sorted
+        keys); returns the number of events written."""
+        evs = self.events()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            for ev in evs:
+                f.write(json.dumps(ev, sort_keys=True) + "\n")
+        os.replace(tmp, path)
+        return len(evs)
+
+    def fold_into_tracer(self, tracer) -> int:
+        """Fold the ring into a ``SpanTracer`` as instant events so flight
+        history renders on the Perfetto timeline next to the host spans."""
+        evs = self.events()
+        chrome = []
+        for ev in evs:
+            args = {k: v for k, v in ev.items() if k not in ("kind", "ts")}
+            chrome.append(
+                {
+                    "name": f"flight.{ev['kind']}",
+                    "ph": "i",
+                    "ts": ev["ts"] * 1e6,  # seconds -> microseconds
+                    "pid": os.getpid(),
+                    "tid": 0,
+                    "s": "t",
+                    "args": args,
+                }
+            )
+        tracer.extend(chrome)
+        return len(chrome)
+
+    # ---- lifecycle ----------------------------------------------------------
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+            self.anomaly_count = 0
+            self.anomalies_by_kind.clear()
+            self._dumped.clear()
+
+
+# ---- Process-wide default instance ------------------------------------------
+
+_RECORDER = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def record(kind: str, **fields: Any) -> None:
+    _RECORDER.record(kind, **fields)
+
+
+def anomaly(kind: str, **fields: Any) -> None:
+    _RECORDER.anomaly(kind, **fields)
+
+
+def enabled() -> bool:
+    return _RECORDER.enabled
+
+
+def set_enabled(on: bool) -> None:
+    _RECORDER.enabled = on
+
+
+def reset() -> None:
+    _RECORDER.reset()
+
+
+def dump(path: str) -> int:
+    return _RECORDER.dump_jsonl(path)

@@ -36,7 +36,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Iterator, Optional
+from typing import Any, Iterable, Iterator, Optional
 
 __all__ = [
     "Counter",
@@ -61,6 +61,8 @@ __all__ = [
     "snapshot",
     "reset",
     "series_key",
+    "parse_series_key",
+    "render_prometheus",
 ]
 
 
@@ -424,8 +426,24 @@ class SpanTracer:
             self._events.append(ev)
 
     def events(self) -> list[dict[str, Any]]:
+        """Copy of the recorded events. Each event dict (and its ``args``)
+        is copied under the lock so callers can mutate or serialize the
+        result while instrumented threads keep appending."""
         with self._lock:
-            return list(self._events)
+            out = []
+            for ev in self._events:
+                ev = dict(ev)
+                if "args" in ev:
+                    ev["args"] = dict(ev["args"])
+                out.append(ev)
+            return out
+
+    def extend(self, events: Iterable[dict[str, Any]]) -> None:
+        """Append pre-built Chrome trace events (e.g. a folded flight-recorder
+        stream) regardless of the enabled flag — the caller already decided
+        these belong on the timeline."""
+        with self._lock:
+            self._events.extend(dict(ev) for ev in events)
 
     def clear(self) -> None:
         with self._lock:
@@ -523,6 +541,95 @@ def reset() -> None:
     """Clear every series and recorded span (test isolation)."""
     _REGISTRY.reset()
     _TRACER.clear()
+
+
+# ---- Prometheus text exposition ---------------------------------------------
+
+
+def parse_series_key(key: str) -> tuple[str, dict[str, str]]:
+    """Invert ``series_key``: ``name{k=v,...}`` -> ``(name, {k: v})``.
+
+    Label values never contain ``,`` or ``}`` in practice (they are enum-ish
+    protocol strings and small ints — the telemetry-cardinality lint rule
+    enforces the bounded-set discipline), so splitting on delimiters is exact
+    for every series this registry mints.
+    """
+    if "{" not in key:
+        return key, {}
+    name, _, inner = key.partition("{")
+    labels: dict[str, str] = {}
+    for part in inner.rstrip("}").split(","):
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        labels[k] = v
+    return name, labels
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize a registry metric name into the Prometheus grammar
+    (``[a-zA-Z_:][a-zA-Z0-9_:]*``), namespaced under ``p2pdl_``."""
+    cleaned = "".join(
+        c if (c.isascii() and (c.isalnum() or c in "_:")) else "_" for c in name
+    )
+    return "p2pdl_" + cleaned
+
+
+def _prom_label_str(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k])
+        v = v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+        parts.append(f'{k}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def render_prometheus(snap: Optional[dict[str, dict[str, Any]]] = None) -> str:
+    """Render a ``MetricsRegistry.snapshot()`` as Prometheus text exposition
+    (format version 0.0.4).
+
+    Counters become ``<name>_total`` counter families; gauges map directly;
+    histograms are exposed as *summaries* (``quantile`` labels plus
+    ``_sum``/``_count``) because the snapshot carries interpolated
+    p50/p90/p99, not raw cumulative buckets. Pure text-in/text-out over the
+    snapshot dict, so it works identically against the live registry and a
+    snapshot JSON loaded from disk (``cli serve-metrics --telemetry-path``).
+    """
+    if snap is None:
+        snap = _REGISTRY.snapshot()
+
+    def grouped(table: dict[str, Any]):
+        fams: dict[str, list[tuple[dict[str, str], Any]]] = {}
+        for key in sorted(table):
+            name, labels = parse_series_key(key)
+            fams.setdefault(name, []).append((labels, table[key]))
+        return sorted(fams.items())
+
+    lines: list[str] = []
+    for name, series in grouped(snap.get("counters", {})):
+        pname = _prom_name(name) + "_total"
+        lines.append(f"# TYPE {pname} counter")
+        for labels, value in series:
+            lines.append(f"{pname}{_prom_label_str(labels)} {value}")
+    for name, series in grouped(snap.get("gauges", {})):
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} gauge")
+        for labels, value in series:
+            lines.append(f"{pname}{_prom_label_str(labels)} {value}")
+    for name, series in grouped(snap.get("histograms", {})):
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} summary")
+        for labels, hist in series:
+            for q, field in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+                if field in hist:  # empty histograms carry no quantiles
+                    qlabels = dict(labels, quantile=q)
+                    lines.append(f"{pname}{_prom_label_str(qlabels)} {hist[field]}")
+            lstr = _prom_label_str(labels)
+            lines.append(f"{pname}_sum{lstr} {hist['sum']}")
+            lines.append(f"{pname}_count{lstr} {hist['count']}")
+    return "\n".join(lines) + "\n"
 
 
 def traced(name: str, fn, **args: Any):

@@ -188,11 +188,16 @@ def chaos_cfg():
 
 
 def _stripped(records):
-    """Record dicts minus the single wall-clock field."""
+    """Record dicts minus the sanctioned wall-clock fields (duration_s and
+    protocol_health's nested brb_latency_s block)."""
     out = []
     for rec in records:
         d = rec.to_dict()
         d.pop("duration_s")
+        if d.get("protocol_health"):
+            d["protocol_health"] = {
+                k: v for k, v in d["protocol_health"].items() if k != "brb_latency_s"
+            }
         out.append(d)
     return out
 

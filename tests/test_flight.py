@@ -1,0 +1,274 @@
+"""Flight recorder: ring semantics, anomaly accounting, replay exactness.
+
+The unit half exercises ``FlightRecorder`` in isolation (capacity, strip,
+dump, timelines, determinism digest). The integration half pins the two
+contracts that make the recorder safe to leave wired into the protocol:
+
+- replay exactness: two same-seed runs under the same FaultPlan produce
+  bit-identical ``events(strip_time=True)`` streams, and
+- recorder neutrality: the ``RoundRecord`` stream is bit-identical with the
+  recorder on vs off (anomaly *counting* is unconditional; event storage
+  must not feed back into protocol state).
+"""
+
+import json
+
+import jax
+import pytest
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.utils import telemetry
+from p2pdl_tpu.utils.flight import FlightRecorder
+
+requires_spmd = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="driver needs jax.shard_map (set P2PDL_JAX_COMPAT=1 for the shims)",
+)
+
+
+# ------------------------------------------------------------- unit: ring
+
+
+def test_ring_bounds_and_monotonic_seq():
+    rec = FlightRecorder(capacity=4, enabled=True)
+    for i in range(10):
+        rec.record("tick", i=i)
+    evs = rec.events()
+    assert len(evs) == 4
+    assert [ev["n"] for ev in evs] == [6, 7, 8, 9]  # eviction keeps global n
+    s = rec.summary()
+    assert s["events_recorded"] == 10
+    assert s["events_retained"] == 4
+    assert s["kinds"] == {"tick": 4}
+
+
+def test_strip_time_removes_only_ts():
+    rec = FlightRecorder(enabled=True)
+    rec.record("x", a=1)
+    (full,) = rec.events()
+    assert "ts" in full
+    (stripped,) = rec.events(strip_time=True)
+    assert "ts" not in stripped
+    assert stripped["a"] == 1 and stripped["kind"] == "x"
+
+
+def test_disabled_recording_is_a_noop():
+    rec = FlightRecorder(enabled=False)
+    rec.record("x")
+    assert rec.events() == []
+    assert rec.summary()["events_recorded"] == 0
+
+
+def test_anomaly_counting_is_unconditional_when_disabled():
+    # The recorder-on/off bit-identity contract hinges on this: health
+    # summaries read anomaly_count, so it must not depend on `enabled`.
+    rec = FlightRecorder(enabled=False)
+    rec.anomaly("brb_timeout", round=3)
+    rec.anomaly("batch_rejected", round=3)
+    rec.anomaly("brb_timeout", round=4)
+    assert rec.events() == []  # storage honored the disable
+    assert rec.anomaly_count == 3
+    assert rec.anomalies_by_kind == {"brb_timeout": 2, "batch_rejected": 1}
+
+
+def test_dump_jsonl_roundtrip(tmp_path):
+    rec = FlightRecorder(enabled=True)
+    rec.record("a", x=1)
+    rec.anomaly("batch_rejected", round=0, reason="malformed_item")
+    path = tmp_path / "flight.jsonl"
+    n = rec.dump_jsonl(str(path))
+    assert n == 2
+    loaded = [json.loads(line) for line in path.read_text().splitlines()]
+    assert loaded == rec.events()
+    assert loaded[1]["anomaly"] is True
+
+
+def test_dump_on_anomaly_throttles_per_kind_round(tmp_path):
+    rec = FlightRecorder(enabled=True, dump_dir=str(tmp_path))
+    rec.anomaly("brb_timeout", round=2)
+    rec.anomaly("brb_timeout", round=2)  # same (kind, round): no second dump
+    rec.anomaly("brb_timeout", round=3)
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert names == ["flight_brb_timeout_r2.jsonl", "flight_brb_timeout_r3.jsonl"]
+
+
+def test_instance_timeline_reconstruction():
+    rec = FlightRecorder(enabled=True)
+    rec.record("brb_init", sender=3, seq=17, peer=0)
+    rec.record("brb_echo", sender=3, seq=17, peer=0)
+    rec.record("brb_init", sender=5, seq=17, peer=0)  # other instance
+    rec.record("round_begin", round=17)  # non-brb: excluded
+    rec.record("brb_ready", sender=3, seq=17, peer=0, votes=5, quorum=5)
+    rec.record("brb_deliver", sender=3, seq=17, peer=0, votes=3, quorum=3, margin=0)
+    tl = rec.instance_timeline(3, 17)
+    assert [ev["kind"] for ev in tl] == [
+        "brb_init",
+        "brb_echo",
+        "brb_ready",
+        "brb_deliver",
+    ]
+    assert set(rec.instance_timelines()) == {"3:17", "5:17"}
+
+
+def test_determinism_digest_tracks_stripped_stream():
+    def run(extra):
+        rec = FlightRecorder(enabled=True)
+        rec.record("a", x=1)
+        if extra:
+            rec.record("b", x=2)
+        return rec.determinism_digest()
+
+    assert run(False) == run(False)  # ts differs, digest must not
+    assert run(False) != run(True)
+
+
+def test_fold_into_tracer_emits_instant_events():
+    rec = FlightRecorder(enabled=True)
+    rec.record("brb_deliver", sender=1, seq=0, votes=3)
+    tracer = telemetry.SpanTracer()
+    assert rec.fold_into_tracer(tracer) == 1
+    (ev,) = [e for e in tracer.events() if e["name"] == "flight.brb_deliver"]
+    assert ev["ph"] == "i"
+    assert ev["args"]["sender"] == 1
+
+
+def test_reset_clears_everything():
+    rec = FlightRecorder(enabled=True)
+    rec.anomaly("quorum_collapse", round=0)
+    rec.reset()
+    assert rec.events() == []
+    assert rec.anomaly_count == 0
+    assert rec.summary()["events_recorded"] == 0
+
+
+# ----------------------------------------- host-only trust-plane replay
+
+
+def _trust_plane_probe(rec_module):
+    """One committee BRB round on the host hub, flight-recorded."""
+    import hashlib
+
+    from p2pdl_tpu.runtime.driver import _TrustPlane
+
+    cfg = Config(num_peers=8, trainers_per_round=3, byzantine_f=1)
+    trainers = [0, 3, 5]
+    plane = _TrustPlane(cfg)
+    digests = {t: hashlib.sha256(b"probe-%d" % t).digest() for t in trainers}
+    plane.run_round(0, trainers, digests)
+    for pid, bc in enumerate(plane.broadcasters):
+        bc.prune(1, report_timeouts=True)
+    return rec_module.recorder().events(strip_time=True)
+
+
+def test_trust_plane_flight_stream_is_replay_exact():
+    from p2pdl_tpu.utils import flight
+
+    prior = flight.enabled()
+    try:
+        flight.set_enabled(True)
+        flight.reset()
+        a = _trust_plane_probe(flight)
+        flight.reset()
+        b = _trust_plane_probe(flight)
+    finally:
+        flight.reset()
+        flight.set_enabled(prior)
+    assert a == b
+    assert any(ev["kind"] == "brb_deliver" for ev in a)
+
+
+# --------------------------------------------- end-to-end (SPMD driver)
+
+
+@pytest.fixture(scope="module")
+def flight_cfg():
+    # Mirrors test_chaos's chaos_cfg so the compile cache is shared.
+    return Config(
+        num_peers=8,
+        trainers_per_round=3,
+        rounds=4,
+        local_epochs=1,
+        samples_per_peer=32,
+        batch_size=32,
+        lr=0.05,
+        server_lr=1.0,
+        brb_enabled=True,
+        aggregator="secure_fedavg",
+    )
+
+
+def _stripped(records):
+    out = []
+    for rec in records:
+        d = rec.to_dict()
+        d.pop("duration_s")
+        if d.get("protocol_health"):
+            d["protocol_health"] = {
+                k: v for k, v in d["protocol_health"].items() if k != "brb_latency_s"
+            }
+        out.append(d)
+    return out
+
+
+@pytest.mark.chaos
+@requires_spmd
+def test_flight_events_bit_identical_across_replay(flight_cfg, mesh8):
+    """Two same-seed runs under the same FaultPlan produce bit-identical
+    time-stripped flight event streams — the recorder's acceptance bar."""
+    from p2pdl_tpu.runtime.driver import Experiment
+    from p2pdl_tpu.utils import flight
+
+    def run():
+        flight.reset()
+        exp = Experiment(flight_cfg, fault_plan="crash_drop_partition")
+        exp.run()
+        rec = flight.recorder()
+        return rec.events(strip_time=True), rec.determinism_digest(), exp
+
+    prior = flight.enabled()
+    try:
+        flight.set_enabled(True)
+        events_a, digest_a, exp_a = run()
+        events_b, digest_b, exp_b = run()
+    finally:
+        flight.reset()
+        flight.set_enabled(prior)
+    assert events_a == events_b
+    assert digest_a == digest_b
+    kinds = {ev["kind"] for ev in events_a}
+    # The chaos scenario exercises the full event vocabulary.
+    assert {"round_begin", "brb_init", "brb_deliver", "fault", "d2h",
+            "pipeline_flush"} <= kinds
+    assert _stripped(exp_a.records) == _stripped(exp_b.records)
+
+
+@pytest.mark.chaos
+@requires_spmd
+def test_round_records_identical_recorder_on_vs_off(flight_cfg, mesh8):
+    """Event storage must be observation-only: the RoundRecord stream (incl.
+    the protocol_health block, whose anomaly counts are maintained
+    unconditionally) is bit-identical with the recorder on vs off."""
+    from p2pdl_tpu.runtime.driver import Experiment
+    from p2pdl_tpu.utils import flight
+
+    def run(on):
+        flight.reset()
+        prior = flight.enabled()
+        flight.set_enabled(on)
+        try:
+            exp = Experiment(flight_cfg, fault_plan="crash_drop_partition")
+            exp.run()
+        finally:
+            flight.reset()
+            flight.set_enabled(prior)
+        return exp.records
+
+    recs_on = run(True)
+    recs_off = run(False)
+    assert _stripped(recs_on) == _stripped(recs_off)
+    health = [r.protocol_health for r in recs_on if r.protocol_health]
+    assert health, "BRB rounds must attach a protocol_health block"
+    for h in health:
+        assert h["deliver_quorum"] >= 1
+        assert "quorum_margin_min" in h and "anomalies" in h
+        assert h["brb_latency_s"]["count"] == h["deliveries"]

@@ -103,6 +103,15 @@ BAD_FIXTURES = {
                 self._queue.append(item)
         """,
     ),
+    "cardinality": (
+        "runtime/bad_cardinality.py",
+        """
+        from p2pdl_tpu.utils import telemetry
+
+        def count(pid):
+            telemetry.counter("brb.delivery_failures", peer=pid).inc()
+        """,
+    ),
     "wire": (
         "protocol/bad_signing.py",
         """

@@ -584,3 +584,81 @@ def test_rule_names_are_unique_and_scopes_normalized():
     assert rules_of(lint_source(src, "protocol/fake.py")) == {
         "determinism-wallclock"
     }
+
+
+# ---- telemetry-cardinality --------------------------------------------------
+
+
+def test_identity_label_variable_flagged_in_metric_scope():
+    findings = lint(
+        """
+        from p2pdl_tpu.utils import telemetry
+
+        def count(pid):
+            telemetry.counter("brb.delivery_failures", peer=pid).inc()
+        """,
+        "runtime/fake.py",
+    )
+    assert rules_of(findings) == {"telemetry-cardinality"}
+    assert "peer" in findings[0].message
+
+
+def test_identity_label_on_registry_method_and_gauge_flagged():
+    findings = lint(
+        """
+        def track(self, sender, d):
+            self._registry.gauge("brb.progress", sender=sender).set(1)
+            self._registry.histogram("brb.latency", digest=d.hex()).observe(0.1)
+        """,
+        "protocol/fake.py",
+    )
+    assert rules_of(findings) == {"telemetry-cardinality"}
+    assert len(findings) == 2
+
+
+def test_label_splat_flagged():
+    findings = lint(
+        """
+        from p2pdl_tpu.utils import telemetry
+
+        def count(labels):
+            telemetry.counter("brb.messages", **labels).inc()
+        """,
+        "parallel/fake.py",
+    )
+    assert rules_of(findings) == {"telemetry-label-splat"}
+
+
+def test_constant_and_bounded_labels_are_clean():
+    src = """
+        from p2pdl_tpu.utils import telemetry
+
+        def count(kind):
+            # Constant identity labels partition, they don't explode.
+            telemetry.counter("brb.messages", dir="rx", kind="echo").inc()
+            telemetry.gauge("driver.round_index").set(3)
+            # Non-identity variable labels (enum-ish) are allowed.
+            telemetry.counter("brb.messages", kind=kind).inc()
+            # `bounds` is histogram config, not a label.
+            telemetry.histogram("driver.stage_s", bounds=(0.1, 1.0), stage="d2h")
+        """
+    assert lint(src, "runtime/fake.py") == []
+
+
+def test_cardinality_out_of_scope_and_suppression():
+    src = """
+        from p2pdl_tpu.utils import telemetry
+
+        def count(pid):
+            telemetry.counter("x", peer=pid).inc()
+        """
+    # utils/ is outside the metric scope: emitters there are library code.
+    assert lint(src, "utils/fake.py") == []
+    suppressed = """
+        from p2pdl_tpu.utils import telemetry
+
+        def count(pid):
+            # p2plint: disable=telemetry-cardinality -- bounded O(num_peers)
+            telemetry.counter("x", peer=pid).inc()
+        """
+    assert lint(suppressed, "runtime/fake.py") == []

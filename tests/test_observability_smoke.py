@@ -129,3 +129,318 @@ def test_cli_report_without_log_path_fails_cleanly(tmp_path):
     proc = _run([sys.executable, "-m", "p2pdl_tpu.cli", "report"], tmp_path)
     assert proc.returncode == 2
     assert proc.stdout.strip() == ""
+
+
+def _report_inputs(tmp_path):
+    """A metrics JSONL with protocol_health blocks + a flight dump."""
+    log_path = tmp_path / "metrics.jsonl"
+    records = [
+        {
+            "round": r,
+            "trainers": [0, 1],
+            "train_loss": 2.5 - 0.1 * r,
+            "eval_loss": 2.4 - 0.05 * r,
+            "eval_acc": 0.1 + 0.05 * r,
+            "duration_s": 1.0 if r == 0 else 0.1,
+            "brb_delivered": 4,
+            "brb_failed_peers": [],
+            "brb_excluded_trainers": [],
+            "control_messages": 100,
+            "control_bytes": 5000,
+            "protocol_health": {
+                "live_committee": 8,
+                "deliver_quorum": 3,
+                "quorum_margin_min": 2 - r,
+                "deliveries": 24,
+                "anomalies": 1 if r == 2 else 0,
+                "brb_latency_s": {"count": 24, "p50": 0.001, "p90": 0.002,
+                                  "p99": 0.003, "max": 0.004},
+            },
+        }
+        for r in range(3)
+    ]
+    log_path.write_text("".join(json.dumps(r) + "\n" for r in records))
+    flight_path = tmp_path / "flight.jsonl"
+    events = [
+        {"n": 0, "kind": "round_begin", "ts": 0.1, "round": 0},
+        {"n": 1, "kind": "brb_deliver", "ts": 0.2, "sender": 0, "seq": 0},
+        {"n": 2, "kind": "batch_rejected", "ts": 0.3, "anomaly": True, "round": 2},
+    ]
+    flight_path.write_text("".join(json.dumps(e) + "\n" for e in events))
+    return log_path, flight_path
+
+
+def test_cli_report_renders_protocol_health_and_flight_sections(tmp_path):
+    log_path, flight_path = _report_inputs(tmp_path)
+    proc = _run(
+        [
+            sys.executable, "-m", "p2pdl_tpu.cli", "report",
+            "--log-path", str(log_path), "--flight-path", str(flight_path),
+        ],
+        tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    assert "## Protocol health" in out
+    assert "min quorum margin" in out
+    assert "## Flight recorder" in out
+    assert "batch_rejected: 1" in out
+
+
+def test_cli_report_json_mirrors_markdown_numbers(tmp_path):
+    log_path, flight_path = _report_inputs(tmp_path)
+    proc = _run(
+        [
+            sys.executable, "-m", "p2pdl_tpu.cli", "report", "--json",
+            "--log-path", str(log_path), "--flight-path", str(flight_path),
+        ],
+        tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    data = json.loads(proc.stdout)
+    assert data["rounds"]["count"] == 3
+    assert data["trust_plane"]["rounds_with_brb"] == 3
+    assert data["protocol_health"]["quorum_margin_min"] == 0
+    assert data["protocol_health"]["anomalies_total"] == 1
+    assert data["protocol_health"]["brb_latency_p99_worst_s"] == 0.003
+    assert data["flight"]["events"] == 3
+    assert data["flight"]["anomaly_count"] == 1
+
+
+# --------------------------------------------- Prometheus text exposition
+
+
+def parse_prometheus_text(text):
+    """Hand-rolled Prometheus 0.0.4 text parser: returns
+    ``(types, samples)`` where ``types`` maps metric name -> declared type
+    and ``samples`` maps sample name (incl. labels) -> float value.
+    Raises AssertionError on any malformed line — the golden-format check.
+    """
+    types = {}
+    samples = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert kind in ("counter", "gauge", "summary", "histogram"), line
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue  # HELP / comments
+        # Sample: name[{labels}] value
+        assert not line[0].isspace(), f"continuation line: {line!r}"
+        if "{" in line:
+            name, _, rest = line.partition("{")
+            labels, _, value = rest.rpartition("} ")
+            assert labels or rest.startswith("}"), line
+            for pair in _split_labels(labels):
+                k, eq, v = pair.partition("=")
+                assert eq and v.startswith('"') and v.endswith('"'), line
+                assert _valid_name(k), f"bad label name {k!r}"
+            key = f"{name}{{{labels}}}"
+        else:
+            name, _, value = line.partition(" ")
+            key = name
+        assert _valid_name(name), f"bad metric name {name!r}"
+        samples[key] = float(value)
+    # Every sample must belong to a TYPE-declared family.
+    for key in samples:
+        base = key.partition("{")[0]
+        family = [
+            t for t in types
+            if base == t or base in (f"{t}_sum", f"{t}_count", f"{t}_total")
+        ]
+        assert family, f"sample {key!r} has no TYPE declaration"
+    return types, samples
+
+
+def _split_labels(labels):
+    """Split `a="x",b="y"` on commas outside quotes."""
+    out, cur, in_q, esc = [], "", False, False
+    for ch in labels:
+        if esc:
+            cur += ch
+            esc = False
+        elif ch == "\\":
+            cur += ch
+            esc = True
+        elif ch == '"':
+            cur += ch
+            in_q = not in_q
+        elif ch == "," and not in_q:
+            out.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur:
+        out.append(cur)
+    return out
+
+
+def _valid_name(name):
+    import re
+
+    return re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name) is not None
+
+
+def test_render_prometheus_golden_format():
+    from p2pdl_tpu.utils.telemetry import MetricsRegistry, render_prometheus
+
+    reg = MetricsRegistry()
+    reg.counter("brb.messages", dir="rx", kind="echo").inc(7)
+    reg.counter("driver.d2h_transfers").inc(3)
+    reg.gauge("driver.round_index").set(41)
+    reg.gauge("weird-name", label='va"l\\ue').set(1.5)
+    h = reg.histogram("driver.steady_round_s")
+    for v in (0.1, 0.2, 0.3):
+        h.observe(v)
+    reg.histogram("empty.hist")  # count==0: no quantile keys in to_value()
+    text = render_prometheus(reg.snapshot())
+    assert text.endswith("\n")
+    types, samples = parse_prometheus_text(text)
+    assert types["p2pdl_brb_messages_total"] == "counter"
+    assert samples['p2pdl_brb_messages_total{dir="rx",kind="echo"}'] == 7.0
+    assert samples["p2pdl_driver_d2h_transfers_total"] == 3.0
+    assert types["p2pdl_driver_round_index"] == "gauge"
+    assert samples["p2pdl_driver_round_index"] == 41.0
+    assert samples['p2pdl_weird_name{label="va\\"l\\\\ue"}'] == 1.5
+    assert types["p2pdl_driver_steady_round_s"] == "summary"
+    assert samples["p2pdl_driver_steady_round_s_count"] == 3.0
+    assert 'p2pdl_driver_steady_round_s{quantile="0.5"}' in samples
+    assert samples["p2pdl_empty_hist_count"] == 0.0
+    assert not any(k.startswith("p2pdl_empty_hist{") for k in samples)
+
+
+# ------------------------------------------------- loopback HTTP serving
+
+
+def test_serve_metrics_loopback_while_writing(tmp_path):
+    """/metrics serves valid Prometheus text over loopback while another
+    thread keeps incrementing counters — the scrape-mid-run contract."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from p2pdl_tpu.runtime.server import PROMETHEUS_CONTENT_TYPE, serve_metrics
+    from p2pdl_tpu.utils import flight, telemetry
+
+    reg = telemetry.MetricsRegistry()
+    reg.counter("smoke.rounds").inc()
+    server = serve_metrics(port=0, snapshot_fn=reg.snapshot)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            reg.counter("smoke.rounds").inc()
+            reg.gauge("smoke.round_index").set(reg.counter("smoke.rounds").value)
+
+    w = threading.Thread(target=writer, daemon=True)
+    w.start()
+    try:
+        for _ in range(5):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"] == PROMETHEUS_CONTENT_TYPE
+                _, samples = parse_prometheus_text(resp.read().decode())
+            assert samples["p2pdl_smoke_rounds_total"] >= 1.0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as resp:
+            health = json.loads(resp.read())
+        assert health["status"] == "ok"
+        assert health["anomaly_count"] == flight.recorder().anomaly_count
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/flight", timeout=10
+        ) as resp:
+            fl = json.loads(resp.read())
+        assert "summary" in fl and "events" in fl
+        assert all("ts" not in ev for ev in fl["events"])
+        # Unknown path: a JSON error body with a 404, not a reset socket.
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope", timeout=10)
+            raise AssertionError("expected HTTP 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert json.loads(e.read())["error"] == "not found: /nope"
+    finally:
+        stop.set()
+        w.join(timeout=5)
+        server.shutdown()
+        server.server_close()
+
+
+def test_orchestrator_handler_json_errors():
+    """The orchestrator's handler answers malformed POSTs with 400 JSON and
+    unknown routes with 404 JSON (no jax: a stub state duck-types the
+    orchestrator surface)."""
+    import threading
+    import urllib.error
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    from p2pdl_tpu.runtime.server import make_handler
+
+    class _Records(list):
+        pass
+
+    class _Stub:
+        lock = threading.Lock()
+        training = False
+
+        class cfg:
+            num_peers = 8
+
+        class cluster:
+            class experiment:
+                records = _Records()
+
+        @staticmethod
+        def start_training():
+            return 200, {"status": "completed", "learning_progress": []}
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(_Stub))
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/status", timeout=10
+        ) as resp:
+            assert json.loads(resp.read())["status"] == "idle"
+        # Malformed JSON body -> 400 with a JSON error, connection intact.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/start_training",
+            data=b"{not json",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "malformed JSON body" in json.loads(e.read())["error"]
+        # Unknown POST route -> 404 JSON.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/bogus", data=b"{}"
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected HTTP 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert json.loads(e.read())["error"] == "not found: /bogus"
+        # A valid POST still works after the malformed ones.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/start_training", data=b"{}"
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read())["status"] == "completed"
+    finally:
+        server.shutdown()
+        server.server_close()
