@@ -49,6 +49,31 @@ import traceback
 
 _PROBE_OK_ENV = "P2PDL_BENCH_EARLY_PROBE_OK"
 
+
+def _env_float(name: str, default: float) -> float:
+    """Tolerant env float (mirrors ``telemetry.env_float`` — which cannot be
+    imported here: the package __init__ pulls jax_compat, whose
+    ``P2PDL_JAX_COMPAT=1`` auto-install imports jax, and this section must
+    run before any import that a wedged tunnel can hang)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+# One knob for every probe site (the early gate, the CPU-fallback gate, and
+# main()'s pre-job heal probes): a loaded CI host can need more than the
+# 180s default, a smoke run can want far less.
+PROBE_TIMEOUT_S = _env_float("P2PDL_BENCH_PROBE_TIMEOUT", 180.0)
+
+# Per-attempt probe outcomes, in order, across every probe_backend() call
+# this process made — attached to unreachable records so a dead run says
+# exactly how it died (N timeouts at M seconds vs. instant import errors).
+_PROBE_DIAGNOSTICS: list = []
+
 # Artifact paths (defined before the early gate: the unreachable-record
 # path reads the stages file for provenance before any jax import).
 STAGES_PATH = "BENCH_STAGES.json"
@@ -57,7 +82,7 @@ MATRIX_PATH = "BENCH_MATRIX.json"
 
 def probe_backend(
     attempts: int = 3,
-    timeout_s: float = 180.0,
+    timeout_s: float | None = None,
     sleep_s: float = 60.0,
     env: dict | None = None,
 ) -> bool:
@@ -65,15 +90,28 @@ def probe_backend(
     probe implementation — the early __main__ gate and main()'s
     _device_healthy both use it, so constants/record semantics can't
     drift. ``env`` overlays the subprocess environment (the CPU-fallback
-    gate probes with ``JAX_PLATFORMS=cpu``)."""
+    gate probes with ``JAX_PLATFORMS=cpu``). ``timeout_s=None`` resolves
+    to ``PROBE_TIMEOUT_S`` (``P2PDL_BENCH_PROBE_TIMEOUT``); every attempt
+    appends an outcome row to ``_PROBE_DIAGNOSTICS``."""
     import subprocess
 
+    if timeout_s is None:
+        timeout_s = PROBE_TIMEOUT_S
     code = (
         "import jax, jax.numpy as jnp;"
         "jnp.sum(jnp.ones((128,128)) @ jnp.ones((128,128))).block_until_ready();"
         "print('bench-probe-ok')"
     )
     for i in range(1, attempts + 1):
+        t0 = time.perf_counter()
+        diag = {
+            "attempt": i,
+            "attempts": attempts,
+            "timeout_s": timeout_s,
+            "platform": (env or {}).get(
+                "JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "")
+            ) or "default",
+        }
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code],
@@ -82,14 +120,22 @@ def probe_backend(
                 text=True,
                 env=None if env is None else {**os.environ, **env},
             )
+            diag["elapsed_s"] = round(time.perf_counter() - t0, 3)
             if "bench-probe-ok" in r.stdout:
+                diag["outcome"] = "ok"
+                _PROBE_DIAGNOSTICS.append(diag)
                 return True
+            diag["outcome"] = "failed"
+            diag["stderr_tail"] = r.stderr[-200:]
             print(f"[bench] probe {i}/{attempts} failed: {r.stderr[-200:]}", file=sys.stderr)
         except subprocess.TimeoutExpired:
+            diag["elapsed_s"] = round(time.perf_counter() - t0, 3)
+            diag["outcome"] = "timeout"
             print(
                 f"[bench] probe {i}/{attempts} hung >{timeout_s}s (wedged tunnel?)",
                 file=sys.stderr,
             )
+        _PROBE_DIAGNOSTICS.append(diag)
         if i < attempts:
             time.sleep(sleep_s)
     return False
@@ -102,8 +148,15 @@ def _unreachable_record_for_mode(argv: list[str]) -> dict:
         "device backend unreachable (early probe: jax import/compute hung "
         "in 3 subprocess attempts)"
     )
+    # Per-attempt forensics (outcome / elapsed / timeout budget) ride on
+    # every unreachable record: "3 timeouts at 180s each" and "3 instant
+    # import errors" need different operator responses.
+    diags = list(_PROBE_DIAGNOSTICS)
     if "--matrix" in argv:
-        return {"metric": "bench_matrix", "error": err, "entries": []}
+        return {
+            "metric": "bench_matrix", "error": err, "entries": [],
+            "probe_diagnostics": diags,
+        }
     if "--time-to-acc" in argv:
         return {
             "metric": "cifar10_time_to_70pct_acc",
@@ -111,6 +164,7 @@ def _unreachable_record_for_mode(argv: list[str]) -> dict:
             "unit": "seconds",
             "reached": False,
             "error": err,
+            "probe_diagnostics": diags,
         }
     rec = {
         "metric": "agg_rounds_per_sec_1024peers_mlp",
@@ -118,6 +172,7 @@ def _unreachable_record_for_mode(argv: list[str]) -> dict:
         "unit": "rounds/sec",
         "vs_baseline": 0.0,
         "error": err,
+        "probe_diagnostics": diags,
     }
     # A wedged tunnel at run time must not erase the provenance of real
     # numbers captured earlier: attach the best prior staged capture (with
@@ -203,10 +258,10 @@ from p2pdl_tpu.parallel import (
     build_round_fn,
     init_peer_state,
     make_mesh,
-    params_layout,
     peer_sharding,
     shard_state,
 )
+from p2pdl_tpu.utils import devprof
 
 NORTH_STAR_ROUNDS_PER_SEC = 50.0
 
@@ -255,82 +310,33 @@ def _with_retry(fn, name: str, attempts: int = 3, backoff_s: float = 15.0):
     return None, last
 
 
-# Peak dense-matmul throughput per chip at the bench's compute dtype
-# (bfloat16), keyed by substring of ``device_kind``. Published numbers:
-# v5e 197 TF, v4 275 TF, v3 123 TF, v6e (Trillium) 918 TF.
-_PEAK_BF16_FLOPS = (
-    ("v5 lite", 197e12),
-    ("v5e", 197e12),
-    ("v5p", 459e12),
-    ("v6 lite", 918e12),
-    ("v6e", 918e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-)
+# Cost-model accounting lives in p2pdl_tpu.utils.devprof (the driver's
+# performance-attribution plane uses the same code, so bench MFU and the
+# live driver.mfu gauge can never disagree on methodology). These thin
+# aliases keep bench's historical call sites/signatures.
 
 
 def peak_flops() -> float | None:
-    """Per-chip peak FLOP/s for MFU accounting; ``P2PDL_PEAK_FLOPS``
-    overrides (and is how a CPU smoke run can exercise the path). None when
-    the device kind is unknown — mfu is then omitted, never guessed."""
-    env = os.environ.get("P2PDL_PEAK_FLOPS")
-    if env:
-        return float(env)
-    kind = jax.devices()[0].device_kind.lower()
-    for sub, peak in _PEAK_BF16_FLOPS:
-        if sub in kind:
-            return peak
-    return None
+    """Per-chip peak FLOP/s for MFU accounting (``P2PDL_PEAK_FLOPS``
+    overrides); see ``devprof.peak_flops``."""
+    return devprof.peak_flops()
 
 
 def _compiled_flops(compiled) -> float | None:
     """XLA's own FLOP count for one executable dispatch (the compiler's
     cost model over the optimized HLO — no hand-counted estimates)."""
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        f = float(ca.get("flops", 0.0))
-        return f if f > 0 else None
-    except Exception:
-        return None  # backend without cost analysis (e.g. remote tunnel)
+    flops, _ = devprof.compiled_cost(compiled)
+    return flops
 
 
 def _round_model_flops(cfg: Config, data) -> float | None:
-    """Model FLOPs of one federated round = XLA-counted FLOPs of ONE
-    scan-free local grad step x steps per peer x training peers.
-
-    Deliberately NOT cost_analysis() of the whole round executable: XLA's
-    cost model counts a ``while``/``scan`` body ONCE regardless of trip
-    count, so the fused round / multi-epoch configs would undercount by the
-    trip count. A single unrolled (params, batch) -> grads step has no loop
-    to miscount, and multiplying by the known step/trainer counts is
-    exactly the textbook MFU numerator (model FLOPs, no rematerialization
-    credit). Aggregator/mixing FLOPs are excluded — they are bandwidth, not
-    MXU work — so the reported mfu is conservative."""
-    try:
-        from p2pdl_tpu.parallel.peer_state import build_model
-        from p2pdl_tpu.parallel.round import make_loss_fn  # noqa: PLC0415
-
-        model = build_model(cfg)
-        loss_fn = make_loss_fn(model, jnp.dtype(cfg.compute_dtype))
-        x1 = jnp.zeros((cfg.batch_size,) + tuple(data.x.shape[2:]), data.x.dtype)
-        y1 = jnp.zeros((cfg.batch_size,) + tuple(data.y.shape[2:]), data.y.dtype)
-        params = init_peer_state(cfg).params
-        # Peer-stacked layouts (gossip) carry a leading peer axis on every
-        # leaf; one peer's slice is the model.
-        if params_layout(cfg) == "peer":
-            params = jax.tree.map(lambda p: p[0], params)
-        step = jax.jit(lambda p, x, y: jax.grad(loss_fn)(p, x, y))
-        flops_step = _compiled_flops(step.lower(params, x1, y1).compile())
-        if flops_step is None:
-            return None
-        steps_per_peer = cfg.local_epochs * cfg.batches_per_epoch
-        trainers = cfg.num_peers if cfg.aggregator == "gossip" else cfg.trainers_per_round
-        return flops_step * steps_per_peer * trainers
-    except Exception as e:  # pragma: no cover - diagnostic path
-        _log(f"[bench] model-flops estimate failed: {e!r}")
-        return None
+    """Model FLOPs of one federated round — XLA-measured, never
+    hand-counted; see ``devprof.round_model_flops`` for why it costs one
+    scan-free grad step instead of the whole round executable."""
+    flops = devprof.round_model_flops(cfg, data)
+    if flops is None:  # pragma: no cover - diagnostic path
+        _log("[bench] model-flops estimate unavailable (backend without cost analysis?)")
+    return flops
 
 
 def _mfu_stats(flops_per_round: float | None, rounds_per_sec: float) -> dict:
@@ -1065,15 +1071,16 @@ def _probe_or_heal(metric: str) -> dict | None:
     itself can exceed its timeout on a fully-loaded one-core host)."""
     if os.environ.get("P2PDL_BENCH_SKIP_PROBE"):
         return None
-    # Same 180s the early gate gives the identical probe: a slow-but-
-    # healthy tunnel false-failing here would condemn the whole run.
-    if probe_backend(attempts=1, timeout_s=180.0):
+    # Same budget the early gate gives the identical probe (PROBE_TIMEOUT_S,
+    # one P2PDL_BENCH_PROBE_TIMEOUT knob for every site): a slow-but-healthy
+    # tunnel false-failing here would condemn the whole run.
+    if probe_backend(attempts=1):
         return None
     t0 = time.time()
     while time.time() - t0 < HEAL_WAIT_S:
         _log(f"[bench] tunnel wedged before {metric}; heal-wait {int(time.time() - t0)}s")
         time.sleep(120)
-        if probe_backend(attempts=1, timeout_s=180.0):
+        if probe_backend(attempts=1):
             _log(f"[bench] tunnel healed after {int(time.time() - t0)}s")
             return None
     return {

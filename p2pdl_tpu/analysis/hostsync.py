@@ -12,7 +12,12 @@ blocking sync per call site. This rule flags:
 - ``.item()`` calls with no arguments (the classic scalar sync);
 - ``float()`` / ``int()`` / ``bool()`` casts whose argument mentions a
   device-suggesting expression: a name ending in ``_dev``, the eval-result
-  dict ``ev``, or the on-device ``self.state`` tree.
+  dict ``ev``, or the on-device ``self.state`` tree;
+- ``jax.block_until_ready(...)`` / ``x.block_until_ready()``: a blocking
+  device-completion wait. The perf plane's phase decomposition sanctions
+  exactly one such site (the deferred flush's ``round.device`` sub-phase,
+  where blocking IS the measurement) — anywhere else it serializes the
+  pipelined loop.
 
 Sanctioned sites (the audited single transfer, deferred block-boundary
 readbacks) carry inline ``# p2plint: disable=hostsync-transfer`` comments
@@ -56,7 +61,19 @@ class HostSyncRule(Rule):
             if not isinstance(node, ast.Call):
                 continue
             dotted = mod.dotted(node.func)
-            if dotted in _TRANSFER_FNS:
+            if dotted == "jax.block_until_ready" or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"
+            ):
+                yield mod.finding(
+                    self.name,
+                    node,
+                    "`block_until_ready` blocks the host on device "
+                    "completion; only the deferred flush's round.device "
+                    "sub-phase may wait — elsewhere it serializes the "
+                    "pipelined round loop",
+                )
+            elif dotted in _TRANSFER_FNS:
                 yield mod.finding(
                     self.name,
                     node,

@@ -23,7 +23,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "mode", nargs="?", default="run",
-        choices=["run", "serve", "serve-metrics", "bench", "report", "chaos", "lint"],
+        choices=[
+            "run", "serve", "serve-metrics", "bench", "report", "chaos",
+            "lint", "perf-diff",
+        ],
     )
     p.add_argument("--num-peers", type=int, default=8)
     p.add_argument("--trainers-per-round", type=int, default=3)
@@ -325,6 +328,29 @@ def build_parser() -> argparse.ArgumentParser:
         help="lint mode: directory tree to lint (default: the installed "
         "p2pdl_tpu package)",
     )
+    p.add_argument(
+        "--perf", action="store_true",
+        help="enable the cost-model plane: AOT-compile each program once "
+        "more to extract XLA FLOPs/HBM-bytes/peak-memory and publish the "
+        "driver.mfu / driver.model_flops_per_sec gauges (one extra compile "
+        "per program; the recompile sentinel and phase timers are always on)",
+    )
+    p.add_argument(
+        "--old", default=None, metavar="PATH",
+        help="perf-diff mode: baseline perf/bench JSON (default: the "
+        "second-newest BENCH_r*.json in the current directory)",
+    )
+    p.add_argument(
+        "--new", default=None, metavar="PATH", dest="new_path",
+        help="perf-diff mode: candidate perf/bench JSON (default: the "
+        "newest BENCH_r*.json in the current directory)",
+    )
+    p.add_argument(
+        "--threshold", action="append", default=None, metavar="[METRIC=]FRAC",
+        help="perf-diff mode: allowed relative regression before the exit "
+        "code goes nonzero — a bare fraction sets the default (0.05), "
+        "METRIC=FRAC overrides one metric (repeatable)",
+    )
     p.add_argument("--checkpoint-dir", default=None, help="checkpoint/resume directory")
     p.add_argument("--checkpoint-every", type=int, default=1, help="rounds between checkpoints")
     p.add_argument("--profile-dir", default=None, help="jax.profiler trace output dir")
@@ -495,6 +521,196 @@ def flight_summary_from_events(events: list[dict]) -> dict:
     }
 
 
+# ---- perf-diff: offline regression gate over perf/bench JSON ---------------
+#
+# Pure host path (stdlib json only — no jax), so the gate runs in CI or on a
+# laptop against committed BENCH_r*.json history or two `--perf` run outputs.
+
+# Substring → direction. First match wins; names matching neither direction
+# are carried as informational rows that can never fail the gate.
+_HIGHER_BETTER = ("per_sec", "mfu", "efficiency", "flops_per_sec", "_acc")
+_LOWER_BETTER = (
+    "latency", "recompile", "loss", "bytes", "_memory", "duration", "_s",
+)
+# Wall-clock-free or meaningless-to-compare counters (suffix match on the
+# final path component).
+_DIFF_SKIP = ("count", "rounds", "expected", "monitored", "available", "n", "rc")
+
+
+def metric_direction(name: str) -> str:
+    """'up' (bigger is better), 'down' (smaller is better), or 'info'."""
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf in _DIFF_SKIP or leaf.endswith("hidden_s"):
+        # hidden_s is the GOOD half of the overlap split — judged via
+        # `efficiency`, not on its own.
+        return "info"
+    low = name.lower()
+    for pat in _HIGHER_BETTER:
+        if pat in low:
+            return "up"
+    for pat in _LOWER_BETTER:
+        if pat in low:
+            return "down"
+    return "info"
+
+
+def flatten_perf_metrics(doc: object, prefix: str = "") -> dict[str, float]:
+    """Flatten a perf/bench JSON document into dotted-path numeric leaves.
+
+    Understands the repo's two shapes natively and degrades to a generic
+    recursive flatten for anything else:
+
+    - bench records: ``{"metric": name, "value": v, ...}`` map to
+      ``name: v`` (plus numeric siblings as ``name.sibling``); a record
+      carrying ``error`` + ``last_good`` means the backend was unreachable
+      — its 0.0 headline is a probe artifact, so the last-good record is
+      flattened instead.
+    - driver history wrappers: ``{"parsed": {...}}`` unwrap to the parsed
+      record; run-mode perf output flattens as plain nesting
+      (``phases.round.per_sec``, ``overlap.efficiency``, ...).
+    """
+    out: dict[str, float] = {}
+    if isinstance(doc, dict):
+        if "parsed" in doc and isinstance(doc["parsed"], dict):
+            return flatten_perf_metrics(doc["parsed"], prefix)
+        if doc.get("error") and isinstance(doc.get("last_good"), dict):
+            return flatten_perf_metrics(doc["last_good"], prefix)
+        if isinstance(doc.get("metric"), str) and isinstance(
+            doc.get("value"), (int, float)
+        ):
+            base = (prefix + "." if prefix else "") + doc["metric"]
+            out[base] = float(doc["value"])
+            for k, v in doc.items():
+                if k in ("metric", "value"):
+                    continue
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[f"{base}.{k}"] = float(v)
+            return out
+        for k, v in sorted(doc.items()):
+            key = f"{prefix}.{k}" if prefix else str(k)
+            if isinstance(v, bool):
+                continue
+            if isinstance(v, (int, float)):
+                out[key] = float(v)
+            elif isinstance(v, (dict, list)):
+                out.update(flatten_perf_metrics(v, key))
+    elif isinstance(doc, list):
+        for i, v in enumerate(doc):
+            out.update(flatten_perf_metrics(v, f"{prefix}[{i}]" if prefix else f"[{i}]"))
+    return out
+
+
+def perf_diff(
+    old: dict[str, float],
+    new: dict[str, float],
+    default_threshold: float = 0.05,
+    per_metric: dict[str, float] | None = None,
+) -> dict:
+    """Compare two flattened metric maps with direction-aware thresholds.
+
+    A metric regresses when it moves in its bad direction by more than its
+    threshold, *relatively* (``|delta| / |old|``; an old value of exactly 0
+    compares absolutely so a 0 → 0.1s latency still trips). Metrics present
+    on only one side are reported but never fail the gate — perf planes
+    grow sections over time and the gate must not punish that.
+    """
+    per_metric = per_metric or {}
+    rows = []
+    regressions = 0
+    for name in sorted(set(old) | set(new)):
+        if name not in old or name not in new:
+            rows.append({
+                "metric": name, "old": old.get(name), "new": new.get(name),
+                "status": "only-old" if name in old else "only-new",
+            })
+            continue
+        o, n = old[name], new[name]
+        direction = metric_direction(name)
+        delta = n - o
+        rel = abs(delta) / abs(o) if o != 0 else (0.0 if delta == 0 else abs(delta))
+        threshold = per_metric.get(name, default_threshold)
+        bad = (direction == "up" and delta < 0) or (direction == "down" and delta > 0)
+        status = "ok"
+        if direction == "info":
+            status = "info"
+        elif bad and rel > threshold:
+            status = "regression"
+            regressions += 1
+        rows.append({
+            "metric": name, "old": o, "new": n, "rel_change": rel if o != 0 else None,
+            "direction": direction, "threshold": threshold, "status": status,
+        })
+    return {"regressions": regressions, "rows": rows}
+
+
+def _parse_thresholds(specs: list[str] | None) -> tuple[float, dict[str, float]]:
+    """``--threshold`` values: bare fraction = new default, METRIC=FRAC =
+    one metric's override. Raises ValueError on garbage (usage error)."""
+    default = 0.05
+    per_metric: dict[str, float] = {}
+    for spec in specs or []:
+        if "=" in spec:
+            name, _, frac = spec.rpartition("=")
+            per_metric[name] = float(frac)
+        else:
+            default = float(spec)
+    return default, per_metric
+
+
+def _latest_bench_history(n: int = 2) -> list[str]:
+    import glob
+
+    return sorted(glob.glob("BENCH_r*.json"))[-n:]
+
+
+def run_perf_diff(args: argparse.Namespace) -> int:
+    old_path, new_path = args.old, args.new_path
+    if old_path is None and new_path is None:
+        hist = _latest_bench_history()
+        if len(hist) < 2:
+            _warn(
+                "perf-diff needs --old/--new, or >= 2 BENCH_r*.json files "
+                "in the current directory"
+            )
+            return 2
+        old_path, new_path = hist
+    if old_path is None or new_path is None:
+        _warn("perf-diff needs both --old and --new (or neither)")
+        return 2
+    try:
+        default_threshold, per_metric = _parse_thresholds(args.threshold)
+    except ValueError as e:
+        _warn(f"bad --threshold: {e}")
+        return 2
+    try:
+        with open(old_path) as f:
+            old_doc = json.load(f)
+        with open(new_path) as f:
+            new_doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        _warn(f"perf-diff could not load inputs: {e}")
+        return 2
+    diff = perf_diff(
+        flatten_perf_metrics(old_doc), flatten_perf_metrics(new_doc),
+        default_threshold, per_metric,
+    )
+    diff["old"], diff["new"] = old_path, new_path
+    if args.lint_json:
+        json.dump(diff, sys.stdout, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        lines = [f"# perf-diff: {old_path} -> {new_path}", ""]
+        rows = [
+            [r["metric"], _fmt(r.get("old")), _fmt(r.get("new")),
+             _fmt(r.get("rel_change")), r["status"]]
+            for r in diff["rows"]
+        ]
+        lines += _md_table(["metric", "old", "new", "rel", "status"], rows)
+        lines += ["", f"regressions: {diff['regressions']}"]
+        sys.stdout.write("\n".join(lines) + "\n")
+    return 1 if diff["regressions"] else 0
+
+
 def build_report_data(
     records: list[dict],
     telemetry_snapshot: dict | None = None,
@@ -569,6 +785,16 @@ def build_report_data(
                 "brb_latency_p50_worst_s": max(p50s) if p50s else None,
                 "brb_latency_p99_worst_s": max(p99s) if p99s else None,
             }
+    # The run appends one {"profile": ..., "perf": ...} record to the JSONL
+    # after the round stream; fold the last one into the digest.
+    prof_recs = [r for r in records if isinstance(r, dict) and "profile" in r]
+    if prof_recs:
+        phases = prof_recs[-1].get("profile")
+        if phases:
+            data["phases"] = phases
+        perf = prof_recs[-1].get("perf")
+        if perf:
+            data["perf"] = perf
     if telemetry_snapshot:
         data["telemetry"] = telemetry_snapshot
     if flight_summary:
@@ -636,6 +862,52 @@ def render_report(
             lines += ["## Protocol health", ""] + _md_table(["metric", "value"], rows) + [""]
     else:
         lines += ["_No round records found._", ""]
+
+    phases = data.get("phases")
+    if phases:
+        rows = [
+            [name, _fmt(s.get("count")), _fmt(s.get("mean_s")),
+             _fmt(s.get("p99_s")), _fmt(s.get("per_sec"))]
+            for name, s in phases.items()
+        ]
+        lines += ["## Phase timing", ""] + _md_table(
+            ["phase", "count", "mean (s)", "p99 (s)", "per sec"], rows
+        ) + [""]
+
+    perf = data.get("perf")
+    if perf:
+        rows = []
+        ov = perf.get("overlap") or {}
+        if ov.get("rounds"):
+            rows += [
+                ["pipelined flushes", _fmt(ov.get("rounds"))],
+                ["device tail hidden / exposed (s)",
+                 f"{_fmt(ov.get('hidden_s'))} / {_fmt(ov.get('exposed_s'))}"],
+                ["overlap efficiency", _fmt(ov.get("efficiency"))],
+            ]
+        rc = perf.get("recompile") or {}
+        rows.append(["recompile anomalies", _fmt(rc.get("recompiles"))])
+        progs = rc.get("programs") or {}
+        if progs:
+            rows.append([
+                "compiles per program (actual/expected)",
+                ", ".join(
+                    f"{n}: {p.get('compiles')}/{p.get('expected')}"
+                    for n, p in progs.items()
+                ),
+            ])
+        cm = perf.get("cost_model") or {}
+        if cm:
+            rows += [
+                ["model FLOPs / round (XLA cost model)",
+                 _fmt(cm.get("flops_per_round"))],
+                ["HBM bytes / round", _fmt(cm.get("hbm_bytes_per_round"))],
+                ["device peak memory (bytes)",
+                 _fmt(cm.get("device_peak_memory_bytes"))],
+            ]
+        lines += ["## Performance attribution", ""] + _md_table(
+            ["metric", "value"], rows
+        ) + [""]
 
     fl = data.get("flight")
     if fl:
@@ -762,6 +1034,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.mode == "serve-metrics":
         # Pure host path: the exposition server never imports jax.
         return run_serve_metrics(args)
+    if args.mode == "perf-diff":
+        # Pure host path: the regression gate is stdlib-json only.
+        return run_perf_diff(args)
     if args.mode == "lint":
         # Pure host path: p2plint is stdlib-ast only, no jax/backend init.
         from p2pdl_tpu.analysis import cli_lint
@@ -876,6 +1151,7 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_dir=args.checkpoint_dir, checkpoint_every=args.checkpoint_every,
         profile_dir=args.profile_dir, failure_cooldown_rounds=args.failure_cooldown,
         fault_plan=fault_plan, pipeline=not args.no_pipeline,
+        perf=args.perf,
     )
     emit = lambda rec: print(json.dumps(rec.to_dict()), flush=True)  # noqa: E731
     with exp.profiler.trace():
@@ -898,10 +1174,18 @@ def main(argv: list[str] | None = None) -> int:
             "survival": exp.survival_summary(),
             "fault_plan": exp.faults.plan.to_dict(),
         }))
-    print(json.dumps({
+    perf_record = {
         "profile": exp.profiler.summary(),
-        "telemetry": telemetry.snapshot(),
-    }))
+        "perf": exp.perf_summary(),
+    }
+    if args.log_path:
+        # Trailing perf record in the metrics JSONL: report mode renders
+        # it as '## Phase timing' / '## Performance attribution', and
+        # perf-diff can gate on two of these files. Round consumers filter
+        # on the 'round' key, so the extra record is invisible to them.
+        with open(args.log_path, "a") as f:
+            f.write(json.dumps(perf_record) + "\n")
+    print(json.dumps({**perf_record, "telemetry": telemetry.snapshot()}))
     return 0
 
 

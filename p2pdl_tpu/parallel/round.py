@@ -759,9 +759,30 @@ def build_round_fn(
     # (for gossip, num_peers × model) through HBM just to preserve a buffer
     # no caller reads again.
     # traced(): each dispatch (trace/compile on first call, async enqueue
-    # after) shows as a "dispatch.*" span when event tracing is on.
+    # after) shows as a "dispatch.*" span when event tracing is on; the
+    # wrapper's ``program_name`` ("round") keys the driver's recompile
+    # sentinel and cost-model registries.
     return telemetry.traced(
         "dispatch.round", jax.jit(round_fn, donate_argnums=(0,))
+    )
+
+
+def fused_block_sizes(
+    rounds: int, rounds_per_call: int, start: int = 0
+) -> tuple[int, ...]:
+    """Distinct scan-block lengths ``run_fused`` will dispatch from
+    ``start``: the trainer matrix is ``[block, T]``, so each distinct block
+    length is one LEGITIMATE compile of the multi_round program (the tail
+    block is shorter unless ``rounds_per_call`` divides the remaining
+    rounds). The recompile sentinel's ``expected`` for ``multi_round`` is
+    the length of this tuple — anything beyond it is an anomaly."""
+    return tuple(
+        sorted(
+            {
+                min(rounds_per_call, rounds - r0)
+                for r0 in range(start, rounds, rounds_per_call)
+            }
+        )
     )
 
 

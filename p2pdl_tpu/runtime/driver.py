@@ -53,7 +53,7 @@ from p2pdl_tpu.protocol.transport import (
     brb_to_wire,
     control_from_wire,
 )
-from p2pdl_tpu.utils import flight, telemetry
+from p2pdl_tpu.utils import devprof, flight, telemetry
 from p2pdl_tpu.utils.metrics import MetricsLogger
 from p2pdl_tpu.utils.profiling import Profiler
 
@@ -444,6 +444,7 @@ class Experiment:
         failure_cooldown_rounds: int = 0,
         fault_plan: Optional[Any] = None,
         pipeline: bool = True,
+        perf: bool = False,
     ) -> None:
         self.cfg = cfg
         self.attack = attack
@@ -561,6 +562,26 @@ class Experiment:
             # fault model; partitions are pushed per round (apply_round).
             self.faults.install(self.trust.hub)
         self.profiler = Profiler(profile_dir)
+        # Performance-attribution plane. The recompile sentinel is ALWAYS
+        # on: its per-round check is a host-side jit-cache-size probe (no
+        # device sync), and "no recompile" is a load-bearing invariant that
+        # deserves runtime detection, not just comments. The XLA cost-model
+        # capture is opt-in (``perf=True`` / ``cli run --perf``): its AOT
+        # ``lower().compile()`` snapshot costs one extra backend compile
+        # per program (the AOT executable does not share the jit cache).
+        self.sentinel = devprof.RecompileSentinel()
+        self.cost_model = (
+            devprof.CostModel(n_devices=self.mesh.devices.size) if perf else None
+        )
+        for fn in (
+            self.round_fn,
+            getattr(self, "train_fn", None),
+            getattr(self, "agg_fn", None),
+            getattr(self, "mix_fn", None),
+            self.eval_fn,
+        ):
+            if fn is not None:
+                self.sentinel.register(getattr(fn, "program_name", "round"), fn)
 
         # Last known per-peer local losses (power_of_choice selection).
         # OBSERVATIONAL runtime state, like the failure-suspicion table:
@@ -673,10 +694,18 @@ class Experiment:
             padded = live
         if self._digest_pack is None:
             self._digest_pack = build_digest_pack_fn(delta)
+            self.sentinel.register(
+                getattr(self._digest_pack[0], "program_name", "digest_pack"),
+                self._digest_pack[0],
+            )
         pack_fn, hash_row = self._digest_pack
         # p2plint: disable=hostsync-transfer -- host-side trainer-id list, no device buffer involved
         padded_host = np.asarray(padded)
-        packed = pack_fn(delta, jnp.asarray(padded_host, jnp.int32))
+        padded_dev = jnp.asarray(padded_host, jnp.int32)
+        if self.cost_model is not None:
+            self.cost_model.capture("digest_pack", pack_fn, (delta, padded_dev))
+        with self.sentinel.guard("digest_pack", r):
+            packed = pack_fn(delta, padded_dev)
         # p2plint: disable=hostsync-transfer -- THE audited single device->host transfer per round (driver.d2h_transfers)
         buf = np.asarray(jax.device_get(packed))  # the round's one D2H
         telemetry.counter("driver.d2h_transfers").inc()
@@ -908,10 +937,17 @@ class Experiment:
                     self._seed_mat = self.secure_keyring.seed_matrix()
                 self._pair_seeds_dev = jnp.asarray(self._seed_mat)
             # BRB-gated pipeline: train -> digest+BRB -> gated aggregate.
-            with self.profiler.phase("round", round=r, trainers=len(live)):
-                delta, new_opt, losses_dev = self.train_fn(
-                    self.state, self.x, self.y, self.byz_gate, mask_key
+            if self.cost_model is not None:
+                self.cost_model.capture(
+                    "train", self.train_fn,
+                    (self.state, self.x, self.y, self.byz_gate, mask_key),
                 )
+            with self.profiler.phase("round", round=r, trainers=len(live)):
+                with self.profiler.phase("round.dispatch", round=r), \
+                        self.sentinel.guard("train", r):
+                    delta, new_opt, losses_dev = self.train_fn(
+                        self.state, self.x, self.y, self.byz_gate, mask_key
+                    )
             with self.profiler.phase(
                 "brb", round=r, trainers=len(live),
                 committee=len(self.trust.committee),
@@ -933,17 +969,26 @@ class Experiment:
                     # Byzantine updates by construction); delivery failures
                     # remain observational -> next-round sampling exclusion.
                     gated = trainers
+            gated_dev = jnp.asarray(gated, jnp.int32)
+            masked_dev = jnp.asarray(trainers, jnp.int32)
+            if self.cost_model is not None:
+                self.cost_model.capture(
+                    "agg", self.agg_fn,
+                    (self.state, delta, new_opt, gated_dev, mask_key),
+                    {"masked_idx": masked_dev, "seeds": self._pair_seeds_dev},
+                )
             with self.profiler.phase("agg", round=r):
                 # masked_idx = the PRE-gate trainer vector: under
                 # secure_fedavg every sampled trainer masked its delta
                 # before the BRB verdict landed, so the aggregate must
                 # cancel the orphaned masks gated-out trainers left behind
                 # (residual_mask_sum; Shamir recovery in a deployment).
-                self.state = self.agg_fn(
-                    self.state, delta, new_opt, jnp.asarray(gated, jnp.int32),
-                    mask_key, masked_idx=jnp.asarray(trainers, jnp.int32),
-                    seeds=self._pair_seeds_dev,
-                )
+                with self.sentinel.guard("agg", r):
+                    self.state = self.agg_fn(
+                        self.state, delta, new_opt, gated_dev,
+                        mask_key, masked_idx=masked_dev,
+                        seeds=self._pair_seeds_dev,
+                    )
             if (
                 self.secure_keyring is not None
                 and self.secure_keyring.shares_distributed
@@ -989,10 +1034,17 @@ class Experiment:
             # round-r mix — exclusion is in-round, not one round late.
             loss_scope = "all"
             set_peer_losses = False
-            with self.profiler.phase("round", round=r, trainers=self.cfg.num_peers):
-                attacked, new_opt, losses_dev, delta = self.train_fn(
-                    self.state, self.x, self.y, self.byz_gate, mask_key
+            if self.cost_model is not None:
+                self.cost_model.capture(
+                    "train", self.train_fn,
+                    (self.state, self.x, self.y, self.byz_gate, mask_key),
                 )
+            with self.profiler.phase("round", round=r, trainers=self.cfg.num_peers):
+                with self.profiler.phase("round.dispatch", round=r), \
+                        self.sentinel.guard("train", r):
+                    attacked, new_opt, losses_dev, delta = self.train_fn(
+                        self.state, self.x, self.y, self.byz_gate, mask_key
+                    )
             with self.profiler.phase(
                 "brb", round=r, trainers=self.cfg.num_peers,
                 committee=len(self.trust.committee),
@@ -1008,20 +1060,35 @@ class Experiment:
                 verdict = np.isin(
                     gossip_live, np.asarray(verified)
                 ).astype(np.float32)
+            verdict_dev = jnp.asarray(verdict)
+            if self.cost_model is not None:
+                self.cost_model.capture(
+                    "mix", self.mix_fn, (self.state, attacked, new_opt, verdict_dev)
+                )
             with self.profiler.phase("agg", round=r):
-                self.state = self.mix_fn(
-                    self.state, attacked, new_opt, jnp.asarray(verdict)
-                )
+                with self.sentinel.guard("mix", r):
+                    self.state = self.mix_fn(
+                        self.state, attacked, new_opt, verdict_dev
+                    )
         else:
-            with self.profiler.phase("round", round=r, trainers=len(live)):
-                self.state, m = self.round_fn(
-                    self.state,
-                    self.x,
-                    self.y,
-                    jnp.asarray(trainers, jnp.int32),
-                    self.byz_gate,
-                    mask_key,
+            trainers_dev = jnp.asarray(trainers, jnp.int32)
+            if self.cost_model is not None:
+                self.cost_model.capture(
+                    "round", self.round_fn,
+                    (self.state, self.x, self.y, trainers_dev,
+                     self.byz_gate, mask_key),
                 )
+            with self.profiler.phase("round", round=r, trainers=len(live)):
+                with self.profiler.phase("round.dispatch", round=r), \
+                        self.sentinel.guard("round", r):
+                    self.state, m = self.round_fn(
+                        self.state,
+                        self.x,
+                        self.y,
+                        trainers_dev,
+                        self.byz_gate,
+                        mask_key,
+                    )
                 # Mean over this round's trainers only — non-trainers' local
                 # losses exist on-device but the reference's progress metric
                 # is trainer loss (``main.py:90-94`` collects from trainer
@@ -1031,11 +1098,21 @@ class Experiment:
                 if self.cfg.aggregator == "gossip":
                     loss_scope = "all"
 
+        if self.cost_model is not None:
+            self.cost_model.capture(
+                "eval", self.eval_fn,
+                (self.state, self.data.eval_x, self.data.eval_y),
+            )
         with self.profiler.phase("eval", round=r):
             # Async dispatch: ev holds device scalars; forcing them here
             # would stall the host on the whole round's device chain, so the
             # float() readbacks happen at flush time, one round late.
-            ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)
+            with self.sentinel.guard("eval", r):
+                ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)
+        # Recompile sentinel: runs INSIDE the round's anomaly watermark, so
+        # an unexpected compile lands in this round's protocol_health
+        # anomaly delta as well as the flight ring + recompiles counter.
+        self.sentinel.check(r)
         # Per-round protocol health: deterministic quorum facts plus the
         # flight recorder's anomaly delta (unconditional counting, so the
         # record is identical with the recorder on or off), plus wall-clock
@@ -1061,6 +1138,10 @@ class Experiment:
             "set_peer_losses": set_peer_losses,
             "ev": ev,
             "duration_s": time.perf_counter() - t0,
+            # Overlap accounting: device work still in flight after this
+            # point runs under the NEXT round's host time; the flush
+            # measures how much of that tail stayed hidden vs. exposed.
+            "dispatch_done_ts": self.profiler.clock(),
             "brb_delivered": brb_delivered,
             "brb_failed": brb_failed,
             "brb_excluded": brb_excluded,
@@ -1098,18 +1179,38 @@ class Experiment:
         if p is None:
             return None
         telemetry.gauge("driver.pipeline_depth").set(0)
-        # p2plint: disable=hostsync-transfer -- sanctioned deferred readback: flushes the previous round after the next one is in flight
-        losses = np.asarray(p["losses_dev"])  # [P]
+        flush_t0 = self.profiler.clock()
+        with self.profiler.phase("round.device", round=p["r"]):
+            # THE sanctioned device-completion site: the flush must consume
+            # these buffers anyway; blocking explicitly here (instead of
+            # letting np.asarray block implicitly below) isolates the
+            # residual device wait from the D2H copy time — the split the
+            # overlap metric is made of.
+            jax.block_until_ready((p["losses_dev"], p["ev"]))  # p2plint: disable=hostsync-transfer -- sanctioned device-completion sub-phase: the deferred flush blocks here by design
+        with self.profiler.phase("round.d2h", round=p["r"]):
+            # p2plint: disable=hostsync-transfer -- sanctioned deferred readback: flushes the previous round after the next one is in flight
+            losses = np.asarray(p["losses_dev"])  # [P]
+            ev = p["ev"]
+            eval_loss = float(ev["eval_loss"])  # p2plint: disable=hostsync-transfer -- ev is host data in the deferred flush
+            eval_acc = float(ev["eval_acc"])  # p2plint: disable=hostsync-transfer -- ev is host data in the deferred flush
+        # hidden = device tail that ran under the next round's host work;
+        # exposed = what this flush actually waited (device residual + D2H).
+        # Host-side wall clock only — feeds gauges/summary, never records.
+        exposed_s = self.profiler.clock() - flush_t0
+        hidden_s = max(0.0, flush_t0 - p["dispatch_done_ts"])
+        self.profiler.add_overlap(hidden_s, exposed_s)
+        eff = self.profiler.overlap.efficiency()
+        if eff is not None:
+            telemetry.gauge("driver.overlap_efficiency").set(eff)
         if p["set_peer_losses"]:
             self._peer_losses = losses  # feeds biased selection
         row = losses if p["loss_scope"] == "all" else losses[p["live"]]
-        ev = p["ev"]
         record = RoundRecord(
             round=p["r"],
             trainers=p["live"].tolist(),
             train_loss=float(np.mean(row)),
-            eval_loss=float(ev["eval_loss"]),  # p2plint: disable=hostsync-transfer -- ev is host data in the deferred flush
-            eval_acc=float(ev["eval_acc"]),  # p2plint: disable=hostsync-transfer -- ev is host data in the deferred flush
+            eval_loss=eval_loss,
+            eval_acc=eval_acc,
             duration_s=p["duration_s"],
             brb_delivered=p["brb_delivered"],
             brb_failed_peers=p["brb_failed"],
@@ -1136,6 +1237,8 @@ class Experiment:
             telemetry.histogram("driver.steady_round_s").observe(record.duration_s)
         if record.duration_s > 0:
             telemetry.gauge("driver.rounds_per_sec").set(1.0 / record.duration_s)
+            if self.cost_model is not None:
+                self.cost_model.observe_round_rate(1.0 / record.duration_s)
         self.records.append(record)
         self.metrics.log(record.to_dict())
         return record
@@ -1201,10 +1304,26 @@ class Experiment:
                 "a fused block — use run() for biased selection"
             )
         from p2pdl_tpu.parallel import build_multi_round_fn
+        from p2pdl_tpu.parallel.round import fused_block_sizes
 
         if not hasattr(self, "_multi_round_fn"):
             self._multi_round_fn = build_multi_round_fn(
                 self.cfg, self.mesh, attack=self.attack
+            )
+            # Each distinct scan-block length (tail blocks are shorter) is
+            # one legitimate compile; anything past that is an anomaly.
+            self.sentinel.register(
+                getattr(self._multi_round_fn, "program_name", "multi_round"),
+                self._multi_round_fn,
+                expected=max(
+                    1,
+                    len(
+                        fused_block_sizes(
+                            self.cfg.rounds, rounds_per_call,
+                            start=int(self.state.round_idx),
+                        )
+                    ),
+                ),
             )
         self._flush_pending_round()  # a prior pipelined loop may have a tail
         base_key = jax.random.PRNGKey(self.cfg.seed)
@@ -1212,26 +1331,42 @@ class Experiment:
             r0 = int(self.state.round_idx)
             block = min(rounds_per_call, self.cfg.rounds - r0)
             trainer_mat = np.stack([self.sample_roles(r0 + i) for i in range(block)])
+            trainer_dev = jnp.asarray(trainer_mat, jnp.int32)
+            if self.cost_model is not None:
+                self.cost_model.capture(
+                    "multi_round", self._multi_round_fn,
+                    (self.state, self.x, self.y, trainer_dev,
+                     self.byz_gate, base_key),
+                )
             t0 = time.perf_counter()
             with self.profiler.phase("round", round=r0, rounds=block):
-                self.state, m = self._multi_round_fn(
-                    self.state,
-                    self.x,
-                    self.y,
-                    jnp.asarray(trainer_mat, jnp.int32),
-                    self.byz_gate,
-                    base_key,
-                )
-                losses = np.asarray(m["train_loss"])  # [R, P]
+                with self.profiler.phase("round.dispatch", round=r0), \
+                        self.sentinel.guard("multi_round", r0):
+                    self.state, m = self._multi_round_fn(
+                        self.state,
+                        self.x,
+                        self.y,
+                        trainer_dev,
+                        self.byz_gate,
+                        base_key,
+                    )
+                with self.profiler.phase("round.d2h", round=r0):
+                    losses = np.asarray(m["train_loss"])  # [R, P]
                 self._peer_losses = losses[-1]  # feeds biased selection
+            self.sentinel.check(r0 + block - 1)
             dt = (time.perf_counter() - t0) / block
             if not getattr(self, "_first_round_done", False):
                 self._first_round_done = True
                 telemetry.gauge("driver.first_round_s").set(dt * block)
             else:
                 telemetry.histogram("driver.steady_round_s").observe(dt)
+            if self.cost_model is not None and dt > 0:
+                self.cost_model.observe_round_rate(1.0 / dt)
             with self.profiler.phase("eval", round=r0 + block - 1):
-                ev = self.eval_fn(self.state, self.data.eval_x, self.data.eval_y)
+                with self.sentinel.guard("eval", r0 + block - 1):
+                    ev = self.eval_fn(
+                        self.state, self.data.eval_x, self.data.eval_y
+                    )
             for i in range(block):
                 live = trainer_mat[i][trainer_mat[i] >= 0]
                 row = losses[i] if self.cfg.aggregator == "gossip" else losses[i][live]
@@ -1287,6 +1422,22 @@ class Experiment:
             ),
             "final_eval_acc": self.records[-1].eval_acc if self.records else None,
         }
+
+    def perf_summary(self) -> dict[str, Any]:
+        """RoundRecord-ADJACENT performance attribution: phase timing,
+        pipelined-readback overlap, recompile accounting, and (with
+        ``perf=True``) the XLA cost model. Deliberately not part of any
+        RoundRecord — every field here is wall-clock- or build-derived, and
+        the record stream's bit-identity contract must hold with the perf
+        plane on or off."""
+        out: dict[str, Any] = {
+            "phases": self.profiler.summary(),
+            "overlap": self.profiler.overlap.to_dict(),
+            "recompile": self.sentinel.summary(),
+        }
+        if self.cost_model is not None:
+            out["cost_model"] = self.cost_model.to_dict()
+        return out
 
     def run_rounds(self, on_record: Optional[Any] = None) -> list[RoundRecord]:
         """The round loop alone (no profiler trace, no final checkpoint —

@@ -25,10 +25,10 @@ counters — cheap int adds on deterministic inputs — so the per-round health
 summary attached to ``RoundRecord`` is identical whether or not event
 storage is enabled (the recorder-on/off bit-identity contract).
 
-Anomalies (delivery timeout, ``batch_rejected``, live-quorum collapse)
-trigger an automatic JSONL dump of the ring when ``P2PDL_FLIGHT_DIR`` is
-set, throttled to one dump per (kind, round) so a noisy round cannot spam
-the disk.
+Anomalies (delivery timeout, ``batch_rejected``, live-quorum collapse,
+``recompile``) trigger an automatic JSONL dump of the ring when
+``P2PDL_FLIGHT_DIR`` is set, throttled to one dump per (kind, round) so a
+noisy round cannot spam the disk.
 """
 
 from __future__ import annotations
@@ -56,9 +56,12 @@ __all__ = [
 
 DEFAULT_CAPACITY = 4096
 
-# The anomaly kinds that trigger dump-on-anomaly. Everything here is a
-# protocol-health violation, not a routine transition.
-ANOMALY_KINDS = ("brb_timeout", "batch_rejected", "quorum_collapse")
+# The anomaly kinds that trigger dump-on-anomaly. Everything here is an
+# invariant violation, not a routine transition: protocol health
+# (delivery timeout, rejected batch frame, live-quorum collapse) plus the
+# performance plane's `recompile` (a compiled program re-traced after its
+# expected compiles — the static-shape discipline broke somewhere).
+ANOMALY_KINDS = ("brb_timeout", "batch_rejected", "quorum_collapse", "recompile")
 
 
 class FlightRecorder:

@@ -146,5 +146,31 @@ def install() -> bool:
     return installed
 
 
+def register_compile_listener(callback) -> bool:
+    """Route JAX's compile-duration monitoring events to
+    ``callback(event_name, duration_s)`` — the recompile sentinel's primary
+    signal. Only backend-compile events are forwarded (tracing/lowering
+    durations also flow through the same listener API and are filtered
+    out). Returns False on builds without ``jax.monitoring`` duration
+    listeners; callers fall back to lowering-signature tracking (the
+    sentinel's per-program ``_cache_size`` probe)."""
+    try:
+        from jax import monitoring
+    except ImportError:
+        return False
+    if not hasattr(monitoring, "register_event_duration_secs_listener"):
+        return False
+
+    def _listener(event: str, duration_s: float, **kwargs) -> None:
+        if "backend_compile" in event:
+            try:
+                callback(event, duration_s)
+            except Exception:
+                pass  # observability must never take down a compile
+
+    monitoring.register_event_duration_secs_listener(_listener)
+    return True
+
+
 if os.environ.get(_ENV, "").lower() in ("1", "on", "true"):
     install()

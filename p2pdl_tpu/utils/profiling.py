@@ -8,41 +8,138 @@ rounds/sec-grade statistics, and — when a trace directory is configured —
 under a ``jax.profiler`` trace whose output loads directly in TensorBoard /
 Perfetto for op-level TPU analysis (MXU utilization, HBM stalls, collective
 time on ICI).
+
+Phase decomposition (the performance-attribution plane): the driver splits
+the coarse ``round`` phase into ``round.dispatch`` (host time until the
+async dispatch returns), ``round.device`` (residual device-completion wait
+at flush, via the sanctioned ``block_until_ready`` site), and ``round.d2h``
+(the deferred readback copies). ``OverlapStats`` folds those into the
+pipelined loop's overlap-efficiency metric: of each round's device tail,
+how much was hidden behind the next round's host work vs. exposed as a
+blocking wait at flush.
 """
 
 from __future__ import annotations
 
 import contextlib
+import random
 import time
 from collections import defaultdict
-from typing import Any, Iterator, Optional
+from typing import Any, Callable, Iterator, Optional
 
 from p2pdl_tpu.utils import telemetry
 
+# ``jax.profiler`` cached at module scope: ``Profiler.phase`` used to
+# re-import it on EVERY phase entry when a trace dir was set — a dict hit
+# in sys.modules, but still an avoidable import-machinery round trip on
+# the per-round hot path.
+_JAX_PROFILER: Any = None
+
+# Bounded per-phase duration reservoir for p50/p90/p99: big enough that
+# steady-state quantiles are sharp, small enough that a million-round run
+# stays O(1) memory per phase.
+RESERVOIR_SIZE = 512
+
+# Deterministic sampling seed (host-only accounting — never feeds protocol
+# state, but determinism keeps two same-seed runs' summaries comparable).
+_RESERVOIR_SEED = 0x5EED
+
+
+def _jax_profiler() -> Any:
+    global _JAX_PROFILER
+    if _JAX_PROFILER is None:
+        import jax.profiler
+
+        _JAX_PROFILER = jax.profiler
+    return _JAX_PROFILER
+
+
+def _quantile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank quantile over an already-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[idx]
+
 
 class PhaseStats:
-    __slots__ = ("count", "total_s", "min_s", "max_s")
+    __slots__ = ("count", "total_s", "min_s", "max_s", "_reservoir", "_rng")
 
     def __init__(self) -> None:
         self.count = 0
         self.total_s = 0.0
         self.min_s = float("inf")
         self.max_s = 0.0
+        self._reservoir: list[float] = []
+        self._rng = random.Random(_RESERVOIR_SEED)
 
     def add(self, dt: float) -> None:
         self.count += 1
         self.total_s += dt
         self.min_s = min(self.min_s, dt)
         self.max_s = max(self.max_s, dt)
+        # Algorithm R reservoir sampling: every observation has equal
+        # probability of being in the sample, with a deterministic RNG.
+        if len(self._reservoir) < RESERVOIR_SIZE:
+            self._reservoir.append(dt)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < RESERVOIR_SIZE:
+                self._reservoir[j] = dt
 
     def to_dict(self) -> dict[str, Any]:
+        srt = sorted(self._reservoir)
         return {
             "count": self.count,
             "total_s": self.total_s,
             "mean_s": self.total_s / self.count if self.count else 0.0,
             "min_s": self.min_s if self.count else 0.0,
             "max_s": self.max_s,
+            "p50_s": _quantile(srt, 0.50),
+            "p90_s": _quantile(srt, 0.90),
+            "p99_s": _quantile(srt, 0.99),
             "per_sec": self.count / self.total_s if self.total_s > 0 else 0.0,
+        }
+
+
+class OverlapStats:
+    """Pipelined-readback overlap accounting.
+
+    Per flushed round the driver reports ``hidden_s`` (wall time between
+    the round's dispatch returning and its flush starting — device
+    execution that ran under the NEXT round's host work) and ``exposed_s``
+    (the blocking device-completion + D2H wait actually paid at flush).
+    ``efficiency`` = hidden / (hidden + exposed): 1.0 means the one-round-
+    late readback hid the whole device tail; 0.0 means the flush ate it
+    all (the synchronous loop's shape). An upper bound — the device may
+    have finished before the flush, in which case some of ``hidden_s`` was
+    idle — but its trend is exactly what ROADMAP item 3's overlap levers
+    move."""
+
+    __slots__ = ("rounds", "hidden_s", "exposed_s")
+
+    def __init__(self) -> None:
+        self.rounds = 0
+        self.hidden_s = 0.0
+        self.exposed_s = 0.0
+
+    def add(self, hidden_s: float, exposed_s: float) -> None:
+        self.rounds += 1
+        self.hidden_s += max(0.0, hidden_s)
+        self.exposed_s += max(0.0, exposed_s)
+
+    def efficiency(self) -> Optional[float]:
+        total = self.hidden_s + self.exposed_s
+        if self.rounds == 0 or total <= 0.0:
+            return None
+        return self.hidden_s / total
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "rounds": self.rounds,
+            "hidden_s": self.hidden_s,
+            "exposed_s": self.exposed_s,
+            "efficiency": self.efficiency(),
         }
 
 
@@ -53,11 +150,21 @@ class Profiler:
     directory set, each phase also records a device trace named after the
     phase. ``summary()`` returns per-phase stats — ``per_sec`` of the
     ``"round"`` phase is the headline aggregation-rounds/sec metric.
+
+    ``clock`` is injectable for tests (defaults to the sanctioned
+    monotonic ``time.perf_counter``); ``overlap`` aggregates the pipelined
+    loop's hidden-vs-exposed device-tail accounting.
     """
 
-    def __init__(self, trace_dir: Optional[str] = None) -> None:
+    def __init__(
+        self,
+        trace_dir: Optional[str] = None,
+        clock: Callable[[], float] = time.perf_counter,
+    ) -> None:
         self.trace_dir = trace_dir
+        self.clock = clock
         self.stats: dict[str, PhaseStats] = defaultdict(PhaseStats)
+        self.overlap = OverlapStats()
 
     @contextlib.contextmanager
     def phase(self, name: str, **span_args: Any) -> Iterator[None]:
@@ -68,15 +175,18 @@ class Profiler:
         two clock reads and a dict update."""
         ctx: contextlib.AbstractContextManager = contextlib.nullcontext()
         if self.trace_dir is not None:
-            import jax.profiler
-
-            ctx = jax.profiler.TraceAnnotation(name)
-        t0 = time.perf_counter()
+            ctx = _jax_profiler().TraceAnnotation(name)
+        t0 = self.clock()
         try:
             with telemetry.span(name, **span_args), ctx:
                 yield
         finally:
-            self.stats[name].add(time.perf_counter() - t0)
+            self.stats[name].add(self.clock() - t0)
+
+    def add_overlap(self, hidden_s: float, exposed_s: float) -> None:
+        """Fold one flushed round's device-tail split into the overlap
+        metric (see :class:`OverlapStats`)."""
+        self.overlap.add(hidden_s, exposed_s)
 
     @contextlib.contextmanager
     def trace(self) -> Iterator[None]:
@@ -84,9 +194,7 @@ class Profiler:
         if self.trace_dir is None:
             yield
             return
-        import jax.profiler
-
-        with jax.profiler.trace(self.trace_dir):
+        with _jax_profiler().trace(self.trace_dir):
             yield
 
     def summary(self) -> dict[str, dict[str, Any]]:

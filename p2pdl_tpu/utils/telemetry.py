@@ -214,6 +214,30 @@ DEFAULT_MAX_SERIES_PER_METRIC = 2048
 OVERFLOW_LABEL = "__other__"
 
 
+def env_int(name: str, default: int) -> int:
+    """Tolerant integer env override: a malformed value must never take
+    down whatever is being configured (registries build at import time,
+    bench probes run before any error channel exists)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    """Tolerant float env override; same contract as :func:`env_int`."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
 class MetricsRegistry:
     """Process-wide labeled metric series.
 
@@ -236,16 +260,9 @@ class MetricsRegistry:
     ) -> None:
         self.enabled = enabled
         if max_series_per_metric is None:
-            # A malformed override must not take down registry construction
-            # (the module-level default registry builds at import time).
-            try:
-                max_series_per_metric = int(
-                    os.environ.get(
-                        "P2PDL_TELEMETRY_MAX_SERIES", DEFAULT_MAX_SERIES_PER_METRIC
-                    )
-                )
-            except ValueError:
-                max_series_per_metric = DEFAULT_MAX_SERIES_PER_METRIC
+            max_series_per_metric = env_int(
+                "P2PDL_TELEMETRY_MAX_SERIES", DEFAULT_MAX_SERIES_PER_METRIC
+            )
         self.max_series_per_metric = max_series_per_metric
         self._lock = threading.Lock()
         self._counters: dict[str, Counter] = {}
@@ -646,4 +663,8 @@ def traced(name: str, fn, **args: Any):
 
     wrapper.__name__ = f"traced_{getattr(fn, '__name__', name)}"
     wrapper.__wrapped__ = fn
+    # Program identity for the perf plane: "dispatch.round" -> "round". The
+    # recompile sentinel and cost model key their registries on this, so a
+    # builder rename stays a one-line change here rather than a driver hunt.
+    wrapper.program_name = name.split(".", 1)[1] if "." in name else name
     return wrapper

@@ -431,6 +431,60 @@ def test_no_recompile_across_trainer_sets_and_vacancies():
 
 
 @requires_spmd
+def test_sentinel_quiet_across_trainer_sets_and_vacancies():
+    """The recompile sentinel's own verdict on the vacancy/selection paths:
+    every registered program stays at (or under) its expected compile
+    count, and no recompile anomaly fires."""
+    exp = Experiment(DRIVER_CFG)
+    exp.run_round(np.array([1, 3, 6]))
+    exp.run_round(np.array([0, 2, -1]))  # shrunken round, vacancy padding
+    exp.run_round(np.array([4, 5, 7]))
+    assert exp.sentinel.recompiles == 0
+    if exp.sentinel.monitored:
+        for name, prog in exp.sentinel.summary()["programs"].items():
+            assert prog["compiles"] <= prog["expected"], (name, prog)
+
+
+@requires_spmd
+def test_sentinel_quiet_in_pipelined_and_chaos_runs():
+    exp = Experiment(DRIVER_CFG, pipeline=True)
+    exp.run()
+    assert exp.sentinel.recompiles == 0
+    exp = Experiment(
+        dataclasses.replace(DRIVER_CFG, rounds=4),
+        pipeline=True,
+        fault_plan="crash_drop_partition",
+    )
+    exp.run()
+    assert exp.sentinel.recompiles == 0
+
+
+@requires_spmd
+def test_sentinel_flags_eval_shape_perturbation_exactly_once():
+    from p2pdl_tpu.utils import flight
+
+    exp = Experiment(DRIVER_CFG)
+    if not exp.sentinel.monitored:
+        pytest.skip("jax.monitoring compile events unavailable on this build")
+    before = flight.recorder().anomalies_by_kind.get("recompile", 0)
+    exp.run_round(np.array([1, 3, 6]))
+    # Shrink the eval set: the eval program must retrace — an intentional,
+    # detectable shape perturbation.
+    exp.data = dataclasses.replace(
+        exp.data,
+        eval_x=exp.data.eval_x[: exp.data.eval_x.shape[0] // 2],
+        eval_y=exp.data.eval_y[: exp.data.eval_y.shape[0] // 2],
+    )
+    exp.run_round(np.array([0, 2, 5]))
+    assert exp.sentinel.recompiles == 1
+    assert exp.sentinel.summary()["programs"]["eval"] == {
+        "compiles": 2,
+        "expected": 1,
+    }
+    assert flight.recorder().anomalies_by_kind.get("recompile", 0) == before + 1
+
+
+@requires_spmd
 def test_pipelined_records_bit_identical():
     recs_sync = Experiment(DRIVER_CFG, pipeline=False).run()
     recs_pipe = Experiment(DRIVER_CFG, pipeline=True).run()

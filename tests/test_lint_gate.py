@@ -85,6 +85,16 @@ BAD_FIXTURES = {
             return arr.item()
         """,
     ),
+    "hostsync-block": (
+        "parallel/round.py",
+        """
+        import jax
+
+        def dispatch(out):
+            jax.block_until_ready(out)
+            return out
+        """,
+    ),
     "locks": (
         "runtime/bad_locks.py",
         """

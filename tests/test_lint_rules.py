@@ -163,6 +163,36 @@ def test_hostsync_transfers_flagged():
     assert [f.rule for f in findings] == ["hostsync-transfer"] * 4
 
 
+def test_hostsync_block_until_ready_flagged():
+    findings = lint(
+        """
+        import jax
+
+        def wait(losses_dev, ev):
+            jax.block_until_ready(losses_dev)
+            ev.block_until_ready()
+            return ev
+        """,
+        HOSTSYNC_PATH,
+    )
+    assert [f.rule for f in findings] == ["hostsync-transfer"] * 2
+    assert all("block_until_ready" in f.message for f in findings)
+
+
+def test_hostsync_block_until_ready_sanctioned_site_suppressed():
+    findings = lint(
+        """
+        import jax
+
+        def flush(pending):
+            jax.block_until_ready(pending)  # p2plint: disable=hostsync-transfer -- sanctioned device-completion sub-phase
+            return pending
+        """,
+        HOSTSYNC_PATH,
+    )
+    assert findings == []
+
+
 def test_hostsync_jnp_asarray_and_plain_casts_clean():
     findings = lint(
         """

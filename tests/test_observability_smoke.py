@@ -207,6 +207,189 @@ def test_cli_report_json_mirrors_markdown_numbers(tmp_path):
     assert data["flight"]["anomaly_count"] == 1
 
 
+def _phase_dict(count, total_s):
+    mean = total_s / count if count else 0.0
+    return {
+        "count": count, "total_s": total_s, "mean_s": mean, "min_s": mean,
+        "max_s": mean, "p50_s": mean, "p90_s": mean, "p99_s": mean,
+        "per_sec": count / total_s if total_s else 0.0,
+    }
+
+
+def _perf_log(tmp_path, name="metrics.jsonl"):
+    """A metrics JSONL ending in the run-appended profile/perf record."""
+    log_path = tmp_path / name
+    records = [
+        {"round": r, "trainers": [0, 1], "train_loss": 2.5 - 0.1 * r,
+         "eval_loss": 2.4, "eval_acc": 0.1, "duration_s": 0.1}
+        for r in range(3)
+    ]
+    perf_record = {
+        "profile": {
+            "round": _phase_dict(3, 0.3),
+            "round.dispatch": _phase_dict(3, 0.25),
+            "round.device": _phase_dict(3, 0.04),
+            "round.d2h": _phase_dict(3, 0.01),
+        },
+        "perf": {
+            "overlap": {"rounds": 3, "hidden_s": 0.09, "exposed_s": 0.01,
+                        "efficiency": 0.9},
+            "recompile": {
+                "recompiles": 0, "monitored": True,
+                "programs": {"round": {"compiles": 1, "expected": 1}},
+            },
+            "cost_model": {
+                "programs": {},
+                "flops_per_round": 6.4e8,
+                "hbm_bytes_per_round": 4.1e7,
+                "device_peak_memory_bytes": 8.5e6,
+            },
+        },
+    }
+    log_path.write_text(
+        "".join(json.dumps(r) + "\n" for r in records + [perf_record])
+    )
+    return log_path
+
+
+def test_cli_report_renders_phase_timing_and_perf_sections(tmp_path):
+    log_path = _perf_log(tmp_path)
+    proc = _run(
+        [sys.executable, "-m", "p2pdl_tpu.cli", "report",
+         "--log-path", str(log_path)],
+        tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    assert "## Phase timing" in out
+    assert "round.dispatch" in out
+    assert "round.d2h" in out
+    assert "## Performance attribution" in out
+    assert "overlap efficiency" in out
+    assert "round: 1/1" in out  # compiles per program
+    assert "model FLOPs / round" in out
+
+
+def test_cli_report_json_carries_phases_and_perf(tmp_path):
+    log_path = _perf_log(tmp_path)
+    proc = _run(
+        [sys.executable, "-m", "p2pdl_tpu.cli", "report", "--json",
+         "--log-path", str(log_path)],
+        tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    data = json.loads(proc.stdout)
+    assert data["rounds"]["count"] == 3  # the perf record is not a round
+    assert data["phases"]["round.device"]["count"] == 3
+    assert data["perf"]["overlap"]["efficiency"] == 0.9
+    assert data["perf"]["recompile"]["recompiles"] == 0
+    assert data["perf"]["cost_model"]["flops_per_round"] == 6.4e8
+
+
+# --------------------------------------------- perf-diff regression gate
+
+
+def _write_bench_record(path, rounds_per_sec, mfu=0.85):
+    path.write_text(json.dumps({
+        "metric": "agg_rounds_per_sec_1024peers_mlp",
+        "value": rounds_per_sec,
+        "unit": "rounds/sec",
+        "flops_per_round": 8.0e10,
+        "mfu": mfu,
+    }))
+
+
+def test_cli_perf_diff_passes_on_identical_inputs(tmp_path):
+    old = tmp_path / "old.json"
+    _write_bench_record(old, 2000.0)
+    proc = _run(
+        [sys.executable, "-m", "p2pdl_tpu.cli", "perf-diff",
+         "--old", str(old), "--new", str(old)],
+        tmp_path,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
+    assert "regressions: 0" in proc.stdout
+
+
+def test_cli_perf_diff_fails_on_20pct_rounds_per_sec_regression(tmp_path):
+    """Acceptance: a synthetic 20% rounds/sec drop must exit nonzero."""
+    old, new = tmp_path / "old.json", tmp_path / "new.json"
+    _write_bench_record(old, 2000.0)
+    _write_bench_record(new, 1600.0)  # -20%
+    proc = _run(
+        [sys.executable, "-m", "p2pdl_tpu.cli", "perf-diff", "--json",
+         "--old", str(old), "--new", str(new)],
+        tmp_path,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr[-2000:]
+    doc = json.loads(proc.stdout)
+    assert doc["regressions"] == 1
+    bad = [r for r in doc["rows"] if r["status"] == "regression"]
+    assert [r["metric"] for r in bad] == ["agg_rounds_per_sec_1024peers_mlp"]
+    assert bad[0]["rel_change"] == 0.2
+
+
+def test_cli_perf_diff_threshold_overrides(tmp_path):
+    old, new = tmp_path / "old.json", tmp_path / "new.json"
+    _write_bench_record(old, 2000.0)
+    _write_bench_record(new, 1600.0)
+    proc = _run(
+        [sys.executable, "-m", "p2pdl_tpu.cli", "perf-diff",
+         "--old", str(old), "--new", str(new), "--threshold", "0.25"],
+        tmp_path,
+    )
+    assert proc.returncode == 0, proc.stdout  # 20% < 25% tolerance
+    proc = _run(
+        [sys.executable, "-m", "p2pdl_tpu.cli", "perf-diff",
+         "--old", str(old), "--new", str(new), "--threshold", "0.25",
+         "--threshold", "agg_rounds_per_sec_1024peers_mlp=0.1"],
+        tmp_path,
+    )
+    assert proc.returncode == 1, proc.stdout  # per-metric override wins
+
+
+def test_cli_perf_diff_usage_errors(tmp_path):
+    proc = _run([sys.executable, "-m", "p2pdl_tpu.cli", "perf-diff"], tmp_path)
+    assert proc.returncode == 2  # no inputs, no BENCH_r*.json in cwd
+    old = tmp_path / "old.json"
+    _write_bench_record(old, 2000.0)
+    proc = _run(
+        [sys.executable, "-m", "p2pdl_tpu.cli", "perf-diff",
+         "--old", str(old), "--new", str(tmp_path / "missing.json")],
+        tmp_path,
+    )
+    assert proc.returncode == 2
+
+
+def test_cli_perf_diff_reads_unreachable_records_via_last_good(tmp_path):
+    """An unreachable-backend record must compare by its last_good payload,
+    not its 0.0 headline — a wedged probe is not a perf regression."""
+    old, new = tmp_path / "old.json", tmp_path / "new.json"
+    _write_bench_record(old, 2000.0)
+    new.write_text(json.dumps({
+        "parsed": {
+            "metric": "agg_rounds_per_sec_1024peers_mlp",
+            "value": 0.0,
+            "unit": "rounds/sec",
+            "error": "device backend unreachable",
+            "last_good": {
+                "metric": "agg_rounds_per_sec_1024peers_mlp",
+                "value": 2000.0,
+                "unit": "rounds/sec",
+                "flops_per_round": 8.0e10,
+                "mfu": 0.85,
+            },
+        },
+    }))
+    proc = _run(
+        [sys.executable, "-m", "p2pdl_tpu.cli", "perf-diff",
+         "--old", str(old), "--new", str(new)],
+        tmp_path,
+    )
+    assert proc.returncode == 0, proc.stdout
+    assert "regressions: 0" in proc.stdout
+
+
 # --------------------------------------------- Prometheus text exposition
 
 

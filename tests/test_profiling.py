@@ -1,0 +1,165 @@
+"""Unit coverage for the phase profiler (utils/profiling.py).
+
+Pinned behavior: bounded deterministic quantile reservoirs, the
+trace_dir=None fast path (phases-only summary), telemetry span emission,
+and the sub-phase/overlap accounting the driver's pipelined flush feeds —
+all exercised with an injectable fake clock so the math is exact.
+"""
+
+import pytest
+
+from p2pdl_tpu.utils import telemetry
+from p2pdl_tpu.utils.profiling import (
+    RESERVOIR_SIZE,
+    OverlapStats,
+    PhaseStats,
+    Profiler,
+    _quantile,
+)
+
+
+class FakeClock:
+    """Deterministic clock: each read returns the next scripted instant,
+    or advances by `step` once the script is exhausted."""
+
+    def __init__(self, step: float = 1.0):
+        self.t = 0.0
+        self.step = step
+
+    def __call__(self) -> float:
+        now = self.t
+        self.t += self.step
+        return now
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---- quantiles --------------------------------------------------------------
+
+
+def test_quantile_nearest_rank_edges():
+    assert _quantile([], 0.5) == 0.0
+    assert _quantile([7.0], 0.0) == 7.0
+    assert _quantile([7.0], 0.99) == 7.0
+    vals = [float(i) for i in range(100)]
+    assert _quantile(vals, 0.50) == 50.0
+    assert _quantile(vals, 0.99) == 99.0
+    assert _quantile(vals, 1.0) == 99.0  # clamped to the last element
+
+
+def test_phase_stats_quantiles_exact_under_reservoir_size():
+    s = PhaseStats()
+    for i in range(100):  # < RESERVOIR_SIZE: the reservoir is the stream
+        s.add(float(i) / 100.0)
+    d = s.to_dict()
+    assert d["p50_s"] == pytest.approx(0.50)
+    assert d["p90_s"] == pytest.approx(0.90)
+    assert d["p99_s"] == pytest.approx(0.99)
+
+
+def test_phase_stats_reservoir_bounded_and_quantiles_sane():
+    s = PhaseStats()
+    n = 10_000
+    for i in range(n):
+        s.add(float(i) / n)  # uniform on [0, 1)
+    assert len(s._reservoir) == RESERVOIR_SIZE
+    d = s.to_dict()
+    assert d["count"] == n
+    # Sampled quantiles of a uniform stream land near the true values.
+    assert d["p50_s"] == pytest.approx(0.5, abs=0.1)
+    assert d["p90_s"] == pytest.approx(0.9, abs=0.1)
+    assert d["p99_s"] == pytest.approx(0.99, abs=0.05)
+    assert d["min_s"] == 0.0
+    assert d["max_s"] == (n - 1) / n
+
+
+def test_phase_stats_reservoir_deterministic():
+    a, b = PhaseStats(), PhaseStats()
+    for i in range(5000):
+        a.add(float(i % 37))
+        b.add(float(i % 37))
+    assert a.to_dict() == b.to_dict()
+
+
+# ---- profiler fast path + spans ---------------------------------------------
+
+
+def test_profiler_no_trace_dir_fast_path_summary_is_phases_only():
+    p = Profiler(trace_dir=None)
+    with p.phase("round"):
+        pass
+    with p.phase("round.dispatch"):
+        pass
+    summary = p.summary()
+    assert list(summary) == ["round", "round.dispatch"]
+    assert summary["round"]["count"] == 1
+    # Overlap lives on p.overlap, never in the phase summary.
+    assert "overlap" not in summary
+
+
+def test_profiler_phase_emits_telemetry_span_with_args():
+    telemetry.start_tracing()
+    try:
+        p = Profiler(trace_dir=None)
+        with p.phase("round.d2h", round=3):
+            pass
+    finally:
+        telemetry.stop_tracing()
+    spans = [e for e in telemetry.tracer().events() if e["ph"] == "X"]
+    assert [s["name"] for s in spans] == ["round.d2h"]
+    assert spans[0]["args"] == {"round": 3}
+
+
+def test_profiler_phase_records_on_exception():
+    p = Profiler(trace_dir=None, clock=FakeClock())
+    with pytest.raises(RuntimeError):
+        with p.phase("round"):
+            raise RuntimeError("boom")
+    assert p.summary()["round"]["count"] == 1
+
+
+# ---- fake-clock sub-phase + overlap accounting ------------------------------
+
+
+def test_profiler_sub_phase_durations_with_fake_clock():
+    clock = FakeClock(step=0.0)
+    p = Profiler(trace_dir=None, clock=clock)
+    with p.phase("round.dispatch"):
+        clock.advance(0.25)
+    with p.phase("round.device"):
+        clock.advance(1.5)
+    with p.phase("round.d2h"):
+        clock.advance(0.125)
+    s = p.summary()
+    assert s["round.dispatch"]["total_s"] == pytest.approx(0.25)
+    assert s["round.device"]["total_s"] == pytest.approx(1.5)
+    assert s["round.d2h"]["total_s"] == pytest.approx(0.125)
+    assert s["round.device"]["per_sec"] == pytest.approx(1 / 1.5)
+
+
+def test_overlap_stats_efficiency_math():
+    o = OverlapStats()
+    assert o.efficiency() is None  # no rounds yet
+    o.add(hidden_s=3.0, exposed_s=1.0)
+    assert o.efficiency() == pytest.approx(0.75)
+    o.add(hidden_s=1.0, exposed_s=3.0)
+    assert o.efficiency() == pytest.approx(0.5)
+    d = o.to_dict()
+    assert d["rounds"] == 2
+    assert d["hidden_s"] == pytest.approx(4.0)
+    assert d["exposed_s"] == pytest.approx(4.0)
+
+
+def test_overlap_stats_clamps_negative_and_zero_total():
+    o = OverlapStats()
+    o.add(hidden_s=-5.0, exposed_s=0.0)  # clock skew must not go negative
+    assert o.hidden_s == 0.0
+    assert o.efficiency() is None  # rounds > 0 but zero accumulated time
+
+
+def test_profiler_add_overlap_feeds_overlap_stats():
+    p = Profiler(trace_dir=None)
+    p.add_overlap(0.9, 0.1)
+    assert p.overlap.rounds == 1
+    assert p.overlap.efficiency() == pytest.approx(0.9)

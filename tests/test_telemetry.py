@@ -212,6 +212,9 @@ def test_phase_stats_zero_count():
         "mean_s": 0.0,
         "min_s": 0.0,
         "max_s": 0.0,
+        "p50_s": 0.0,
+        "p90_s": 0.0,
+        "p99_s": 0.0,
         "per_sec": 0.0,
     }
 
