@@ -199,16 +199,71 @@ class SigningBytesRule(Rule):
                     isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
                     and node.func.attr == "encode"
-                    and isinstance(node.func.value, ast.Call)
-                    and mod.dotted(node.func.value.func) == "str"
                 ):
-                    yield mod.finding(
-                        self.name,
-                        node,
-                        "variable-width `str(...).encode()` field in a "
-                        "signing-bytes builder; use fixed-width struct "
-                        "packing for injectivity",
-                    )
+                    if isinstance(node.func.value, ast.Call) and mod.dotted(
+                        node.func.value.func
+                    ) == "str":
+                        yield mod.finding(
+                            self.name,
+                            node,
+                            "variable-width `str(...).encode()` field in a "
+                            "signing-bytes builder; use fixed-width struct "
+                            "packing for injectivity",
+                        )
+                    elif isinstance(node.func.value, ast.JoinedStr):
+                        yield mod.finding(
+                            self.name,
+                            node,
+                            "variable-width f-string `.encode()` field in a "
+                            "signing-bytes builder; use fixed-width struct "
+                            "packing for injectivity",
+                        )
+                    elif isinstance(node.func.value, ast.Call) and mod.dotted(
+                        node.func.value.func
+                    ) in ("json.dumps", "dumps"):
+                        yield mod.finding(
+                            self.name,
+                            node,
+                            "variable-width `json.dumps(...).encode()` field "
+                            "in a signing-bytes builder; JSON key order and "
+                            "whitespace are not canonical — pack fixed-width "
+                            "struct fields instead",
+                        )
+            yield from self._check_magic_collisions(mod, fn)
+
+    def _check_magic_collisions(
+        self, mod: ModuleInfo, fn: ast.AST
+    ) -> Iterable[Finding]:
+        """A versioned signing builder (the wire v2/v3 pattern) packs one
+        header per revision; two different header layouts sharing one magic
+        would make the revisions mutually forgeable — each struct format
+        must open with its own distinct magic constant."""
+        fmt_by_magic: dict[bytes, str] = {}
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, ast.Call)
+                and mod.dotted(node.func) == "struct.pack"
+                and len(node.args) >= 2
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, (str, bytes))
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, bytes)
+            ):
+                continue
+            fmt = node.args[0].value
+            fmt = fmt.decode("ascii", "replace") if isinstance(fmt, bytes) else fmt
+            magic = node.args[1].value
+            prev = fmt_by_magic.get(magic)
+            if prev is not None and prev != fmt:
+                yield mod.finding(
+                    self.name,
+                    node,
+                    f"signing-bytes builder packs two different header "
+                    f"layouts ({prev!r} and {fmt!r}) under one magic "
+                    f"{magic!r}; each wire revision needs its own magic for "
+                    "mutual injectivity",
+                )
+            fmt_by_magic.setdefault(magic, fmt)
 
 
 _REGISTRY_NAME = re.compile(r"(^|_)(KIND|KINDS|CODE|CODES|REGISTRY)(_|$)")

@@ -25,7 +25,7 @@ def build_parser() -> argparse.ArgumentParser:
         "mode", nargs="?", default="run",
         choices=[
             "run", "serve", "serve-metrics", "bench", "report", "chaos",
-            "lint", "perf-diff",
+            "lint", "perf-diff", "audit",
         ],
     )
     p.add_argument("--num-peers", type=int, default=8)
@@ -311,6 +311,25 @@ def build_parser() -> argparse.ArgumentParser:
         "and dump its ring here at exit; report mode folds the dump into "
         "a '## Flight recorder' section; serve-metrics loads it so "
         "/flight serves a recorded run",
+    )
+    p.add_argument(
+        "--inputs", action="append", default=None, metavar="SRC",
+        help="audit mode: an event stream to merge — a flight JSONL dump "
+        "path or a live server base URL (http://host:port, its /flight "
+        "endpoint is scraped); repeatable, one per peer process",
+    )
+    p.add_argument(
+        "--registered-peers", type=int, default=None, metavar="N",
+        help="audit mode: size of the registered-key universe (voters must "
+        "be in range(N)); default: infer the peer universe from the "
+        "streams themselves",
+    )
+    p.add_argument(
+        "--audit", action="store_true",
+        help="run/chaos modes: run the protocol conformance auditor live "
+        "over the flight stream each round (forces the recorder on); "
+        "violations surface as audit_violation flight anomalies and "
+        "audit.violations counters",
     )
     p.add_argument(
         "--write-baseline", action="store_true",
@@ -797,6 +816,22 @@ def build_report_data(
             data["perf"] = perf
     if telemetry_snapshot:
         data["telemetry"] = telemetry_snapshot
+        # The cardinality cap folds overflow label sets into __other__ and
+        # counts each redirected lookup — surface that as an explicit
+        # warning instead of leaving capped series silently folded.
+        prefix = "telemetry.series_dropped{metric="
+        dropped = {
+            k[len(prefix):-1]: v
+            for k, v in (telemetry_snapshot.get("counters") or {}).items()
+            if k.startswith(prefix) and k.endswith("}")
+        }
+        if dropped:
+            data["warnings"] = [
+                f"telemetry cardinality cap hit: {int(n)} lookup(s) on "
+                f"'{m}' folded into the __other__ series (per-label "
+                "detail lost past the cap)"
+                for m, n in sorted(dropped.items())
+            ]
     if flight_summary:
         data["flight"] = flight_summary
     return data
@@ -815,6 +850,10 @@ def render_report(
     """
     data = build_report_data(records, telemetry_snapshot, flight_summary)
     lines = ["# p2pdl_tpu run report", ""]
+    for w in data.get("warnings") or []:
+        lines.append(f"**WARNING:** {w}")
+    if data.get("warnings"):
+        lines.append("")
     rd = data.get("rounds")
     if rd:
         rows = [
@@ -961,6 +1000,80 @@ def _load_flight_events(path: str) -> list[dict]:
     return events
 
 
+def run_audit(args: argparse.Namespace) -> int:
+    """Offline protocol conformance audit: merge N event streams (flight
+    JSONL dumps and/or live ``/flight`` endpoints) by causal order, run the
+    ``ProtocolAuditor`` over the merged stream, and report the cross-peer
+    causal determinism digest. Exit 1 on any violated invariant, 2 on
+    usage/load errors — pure host path, no jax import."""
+    from p2pdl_tpu.protocol.audit import (
+        ProtocolAuditor,
+        causal_digest,
+        merge_streams,
+    )
+
+    inputs = list(args.inputs or [])
+    if args.flight_path:
+        inputs.append(args.flight_path)
+    if not inputs:
+        _warn(
+            "audit mode needs --inputs (flight JSONL path or "
+            "http://host:port base URL; repeatable)"
+        )
+        return 2
+    streams = []
+    for src in inputs:
+        try:
+            if src.startswith(("http://", "https://")):
+                from urllib.request import urlopen
+
+                with urlopen(src.rstrip("/") + "/flight", timeout=10) as resp:
+                    payload = json.load(resp)
+                streams.append(payload.get("events") or [])
+            else:
+                streams.append(_load_flight_events(src))
+        except (OSError, ValueError) as e:
+            _warn(f"audit could not load {src}: {e}")
+            return 2
+    merged = merge_streams(streams)
+    auditor = ProtocolAuditor(
+        registered=(
+            range(args.registered_peers)
+            if args.registered_peers is not None
+            else None
+        )
+    )
+    violations = auditor.audit(merged)
+    digest = causal_digest(merged)
+    out = {
+        "inputs": inputs,
+        "events": len(merged),
+        "causal_digest": digest,
+        "summary": auditor.summary(),
+        "violations": [v.to_dict() for v in violations],
+    }
+    if args.lint_json:
+        json.dump(out, sys.stdout, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        lines = [
+            f"# protocol audit: {len(merged)} events "
+            f"from {len(inputs)} stream(s)",
+            "",
+            f"causal digest: {digest}",
+        ]
+        if violations:
+            lines.append("")
+            for v in violations:
+                where = f" (round {v.round})" if v.round is not None else ""
+                lines.append(f"VIOLATION [{v.invariant}]{where}: {v.detail}")
+            lines += ["", f"audit FAILED: {len(violations)} violation(s)"]
+        else:
+            lines.append("audit clean: all invariants hold")
+        sys.stdout.write("\n".join(lines) + "\n")
+    return 1 if violations else 0
+
+
 def run_report(args: argparse.Namespace) -> int:
     from p2pdl_tpu.utils.metrics import load_results
 
@@ -1037,6 +1150,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.mode == "perf-diff":
         # Pure host path: the regression gate is stdlib-json only.
         return run_perf_diff(args)
+    if args.mode == "audit":
+        # Pure host path: stream merge + invariant checks, stdlib-json only.
+        return run_audit(args)
     if args.mode == "lint":
         # Pure host path: p2plint is stdlib-ast only, no jax/backend init.
         from p2pdl_tpu.analysis import cli_lint
@@ -1151,7 +1267,7 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_dir=args.checkpoint_dir, checkpoint_every=args.checkpoint_every,
         profile_dir=args.profile_dir, failure_cooldown_rounds=args.failure_cooldown,
         fault_plan=fault_plan, pipeline=not args.no_pipeline,
-        perf=args.perf,
+        perf=args.perf, audit=args.audit,
     )
     emit = lambda rec: print(json.dumps(rec.to_dict()), flush=True)  # noqa: E731
     with exp.profiler.trace():

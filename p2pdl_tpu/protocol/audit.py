@@ -1,0 +1,441 @@
+"""Online/offline protocol conformance auditor over flight event streams.
+
+The BRB skeleton's correctness claims — agreement, quorum arithmetic,
+digest lineage — are *cross-peer* properties: no single peer's counters can
+certify them. This module consumes the flight recorder's structured events
+(live, per round, in the driver; or offline over N JSONL dumps / ``/flight``
+endpoints merged by causal order) and re-checks the safety invariants the
+protocol is supposed to enforce:
+
+- ``conflicting_deliver``: at most one delivered digest per ``(sender,
+  seq)`` across all peers (BRB agreement).
+- ``forged_quorum``: every deliver carries ``votes >= quorum``, its quorum
+  is at least ``2f + 1`` for the instance's declared fault budget, and the
+  recorded READY votes actually reach that quorum when the vote stream is
+  present (no quorum claimed into existence).
+- ``double_vote``: no ``(peer, sender, seq, kind, voter)`` vote is counted
+  twice.
+- ``unregistered_voter``: every counted vote names a voter the run knows a
+  key for (explicit registry, or inferred from the stream's own peer
+  universe).
+- ``non_monotone_reconfig``: growing the suspicion set must never grow the
+  live quorum view (a reconfig that *adds* voters under *more* suspicion is
+  how split-brain quorums are minted).
+- ``tainted_digest``: every digest admitted into aggregation
+  (``agg_admit``) was BRB-delivered for that ``(trainer, round)`` — the
+  digest-lineage taint rule.
+
+Ring-truncation tolerance: the flight ring is a contiguous *suffix* of the
+event stream, so any round whose ``round_begin`` marker survives is fully
+present. Cross-event checks therefore restrict themselves to marked rounds
+when markers exist; a stream with no markers (hand-built fixtures, unit
+probes) is audited in full.
+
+Determinism: the auditor is pure host bookkeeping over already-deterministic
+events — no wall clock, no entropy, sorted traversal everywhere — so the
+merged stream's ``causal_digest`` is bit-identical across same-seed runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Iterable, Optional
+
+__all__ = [
+    "INVARIANTS",
+    "Violation",
+    "ProtocolAuditor",
+    "merge_streams",
+    "causal_digest",
+]
+
+INVARIANTS = (
+    "conflicting_deliver",
+    "forged_quorum",
+    "double_vote",
+    "unregistered_voter",
+    "non_monotone_reconfig",
+    "tainted_digest",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One failed invariant, with enough context to find the evidence."""
+
+    invariant: str
+    detail: str
+    round: Optional[int] = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "invariant": self.invariant,
+            "detail": self.detail,
+            "round": self.round,
+        }
+
+
+def _round_of(ev: dict) -> int:
+    """Round coordinate of an event: explicit ``round``, else the BRB
+    ``seq`` (instances are keyed by round index), else -1 (pre-round)."""
+    r = ev.get("round")
+    if r is None:
+        r = ev.get("seq")
+    return int(r) if isinstance(r, int) else -1
+
+
+class ProtocolAuditor:
+    """Incremental conformance state machine over flight events.
+
+    ``feed(ev)`` applies the per-event checks and accumulates cross-event
+    state; ``check()`` runs the cross-event invariants over everything fed
+    so far. Both are idempotent per violation (each distinct violation is
+    reported exactly once, however often ``check()`` runs), so the driver
+    can call them every round and offline audits once at the end.
+
+    ``registered``: the voter universe (peer ids holding registered keys).
+    When None it is inferred from the stream itself — the peers that appear
+    as instance owners/senders and round trainers.
+    """
+
+    def __init__(self, registered: Optional[Iterable[int]] = None) -> None:
+        self.registered: Optional[frozenset[int]] = (
+            frozenset(int(p) for p in registered)
+            if registered is not None
+            else None
+        )
+        self.violations: list[Violation] = []
+        self._reported: set[tuple] = set()
+        # (sender, seq) -> sorted-unique delivered digest hexes
+        self._delivered: dict[tuple[int, int], list[str]] = {}
+        # brb_deliver facts: (peer, sender, seq, digest, votes, quorum)
+        self._delivers: list[tuple[int, int, int, str, int, int]] = []
+        # (peer, sender, seq) -> f declared at instance init
+        self._init_f: dict[tuple[int, int, int], int] = {}
+        # counted votes: (peer, sender, seq, kind, voter) -> count
+        self._votes: dict[tuple[int, int, int, str, int], int] = {}
+        # READY recount per (peer, sender, seq, digest) -> distinct voters
+        self._ready_voters: dict[tuple[int, int, int, str], set[int]] = {}
+        # quorum_reconfig facts in stream order
+        self._reconfigs: list[dict[str, Any]] = []
+        # agg_admit facts: (round, trainer, digest)
+        self._admits: list[tuple[int, int, str]] = []
+        self._rounds_marked: set[int] = set()
+        self._inferred: set[int] = set()
+
+    # ---- reporting -----------------------------------------------------------
+
+    def _emit(
+        self, invariant: str, key: tuple, detail: str, round: Optional[int]
+    ) -> Optional[Violation]:
+        full_key = (invariant,) + key
+        if full_key in self._reported:
+            return None
+        self._reported.add(full_key)
+        v = Violation(invariant=invariant, detail=detail, round=round)
+        self.violations.append(v)
+        return v
+
+    # ---- ingest --------------------------------------------------------------
+
+    def feed(self, ev: dict) -> list[Violation]:
+        """Consume one event; returns any violations it triggered."""
+        out: list[Violation] = []
+        kind = ev.get("kind")
+        if kind == "round_begin":
+            self._rounds_marked.add(_round_of(ev))
+            for t in ev.get("trainers") or []:
+                self._inferred.add(int(t))
+        elif kind == "brb_init":
+            peer, sender, seq = ev.get("peer"), ev.get("sender"), ev.get("seq")
+            if peer is not None:
+                self._inferred.add(int(peer))
+            if sender is not None:
+                self._inferred.add(int(sender))
+            if peer is not None and sender is not None and seq is not None:
+                f = ev.get("f")
+                if f is not None:
+                    self._init_f[(int(peer), int(sender), int(seq))] = int(f)
+        elif kind == "brb_vote":
+            out.extend(self._feed_vote(ev))
+        elif kind == "brb_deliver":
+            out.extend(self._feed_deliver(ev))
+        elif kind == "quorum_reconfig":
+            self._reconfigs.append(ev)
+        elif kind == "agg_admit":
+            r, t, d = ev.get("round"), ev.get("trainer"), ev.get("digest")
+            if r is not None and t is not None and d is not None:
+                self._admits.append((int(r), int(t), str(d)))
+        elif kind == "membership":
+            p = ev.get("peer")
+            if p is not None:
+                self._inferred.add(int(p))
+        return out
+
+    def _feed_vote(self, ev: dict) -> list[Violation]:
+        out: list[Violation] = []
+        peer, sender, seq = ev.get("peer"), ev.get("sender"), ev.get("seq")
+        vote, voter = ev.get("vote"), ev.get("voter")
+        if None in (sender, seq, vote, voter):
+            return out
+        peer = int(peer) if peer is not None else -1
+        key = (peer, int(sender), int(seq), str(vote), int(voter))
+        self._votes[key] = self._votes.get(key, 0) + 1
+        if self._votes[key] == 2:  # report once, at first duplicate
+            v = self._emit(
+                "double_vote",
+                key,
+                f"peer {peer} counted {vote} vote from {voter} twice for "
+                f"instance ({sender}, {seq})",
+                round=_round_of(ev),
+            )
+            if v:
+                out.append(v)
+        if str(vote) == "ready" and ev.get("digest") is not None:
+            self._ready_voters.setdefault(
+                (peer, int(sender), int(seq), str(ev["digest"])), set()
+            ).add(int(voter))
+        return out
+
+    def _feed_deliver(self, ev: dict) -> list[Violation]:
+        out: list[Violation] = []
+        sender, seq = ev.get("sender"), ev.get("seq")
+        if sender is None or seq is None:
+            return out
+        sender, seq = int(sender), int(seq)
+        peer = int(ev["peer"]) if ev.get("peer") is not None else -1
+        digest = str(ev["digest"]) if ev.get("digest") is not None else None
+        votes = ev.get("votes")
+        quorum = ev.get("quorum")
+        if digest is not None:
+            seen = self._delivered.setdefault((sender, seq), [])
+            if digest not in seen:
+                seen.append(digest)
+                if len(seen) > 1:
+                    v = self._emit(
+                        "conflicting_deliver",
+                        (sender, seq, digest),
+                        f"instance ({sender}, {seq}) delivered "
+                        f"{len(seen)} distinct digests across peers: "
+                        + ", ".join(d[:12] for d in sorted(seen)),
+                        round=seq,
+                    )
+                    if v:
+                        out.append(v)
+        if votes is not None and quorum is not None and int(votes) < int(quorum):
+            v = self._emit(
+                "forged_quorum",
+                ("votes", peer, sender, seq),
+                f"peer {peer} delivered ({sender}, {seq}) with "
+                f"{votes} votes below its own quorum {quorum}",
+                round=seq,
+            )
+            if v:
+                out.append(v)
+        self._delivers.append(
+            (
+                peer,
+                sender,
+                seq,
+                digest if digest is not None else "",
+                int(votes) if votes is not None else -1,
+                int(quorum) if quorum is not None else -1,
+            )
+        )
+        return out
+
+    # ---- cross-event checks --------------------------------------------------
+
+    def _round_complete(self, r: int) -> bool:
+        """True when round ``r``'s events are fully present: either the
+        stream carries no round markers at all (assume complete), or this
+        round's ``round_begin`` survived the ring."""
+        return not self._rounds_marked or r in self._rounds_marked
+
+    def check(self) -> list[Violation]:
+        """Run the cross-event invariants over everything fed so far;
+        returns only violations not already reported."""
+        out: list[Violation] = []
+        out.extend(self._check_quorums())
+        out.extend(self._check_voters())
+        out.extend(self._check_reconfigs())
+        out.extend(self._check_lineage())
+        return out
+
+    def _check_quorums(self) -> list[Violation]:
+        out: list[Violation] = []
+        for peer, sender, seq, digest, votes, quorum in self._delivers:
+            if not self._round_complete(seq):
+                continue
+            f = self._init_f.get((peer, sender, seq))
+            if f is not None and quorum >= 0 and quorum < 2 * f + 1:
+                v = self._emit(
+                    "forged_quorum",
+                    ("config", peer, sender, seq),
+                    f"peer {peer} delivered ({sender}, {seq}) under quorum "
+                    f"{quorum} < 2f+1 = {2 * f + 1}",
+                    round=seq,
+                )
+                if v:
+                    out.append(v)
+            # Recount: the claimed quorum must be backed by distinct
+            # recorded READY votes — only when this instance's vote stream
+            # is present at all (older dumps predate brb_vote).
+            if digest and quorum >= 0:
+                has_votes = any(
+                    k[0] == peer and k[1] == sender and k[2] == seq
+                    for k in self._votes
+                )
+                if has_votes:
+                    backing = len(
+                        self._ready_voters.get((peer, sender, seq, digest), ())
+                    )
+                    if backing < quorum:
+                        v = self._emit(
+                            "forged_quorum",
+                            ("recount", peer, sender, seq, digest),
+                            f"peer {peer} delivered ({sender}, {seq}) "
+                            f"claiming quorum {quorum} but only {backing} "
+                            "distinct ready votes are on record",
+                            round=seq,
+                        )
+                        if v:
+                            out.append(v)
+        return out
+
+    def _check_voters(self) -> list[Violation]:
+        out: list[Violation] = []
+        universe = self.registered
+        if universe is None:
+            if not self._inferred:
+                return out  # nothing to check against
+            universe = frozenset(self._inferred)
+        for key in sorted(self._votes):
+            peer, sender, seq, vote, voter = key
+            if not self._round_complete(seq):
+                continue
+            if voter not in universe:
+                v = self._emit(
+                    "unregistered_voter",
+                    key,
+                    f"peer {peer} counted a {vote} vote from unregistered "
+                    f"peer {voter} for instance ({sender}, {seq})",
+                    round=seq,
+                )
+                if v:
+                    out.append(v)
+        return out
+
+    def _check_reconfigs(self) -> list[Violation]:
+        out: list[Violation] = []
+        for ev in self._reconfigs:
+            live, committee = ev.get("live"), ev.get("committee")
+            if live is not None and committee is not None and live > committee:
+                v = self._emit(
+                    "non_monotone_reconfig",
+                    ("overfull", ev.get("round"), live, committee),
+                    f"round {ev.get('round')} reconfigured to {live} live "
+                    f"voters out of a {committee}-member committee",
+                    round=ev.get("round"),
+                )
+                if v:
+                    out.append(v)
+        for prev, cur in zip(self._reconfigs, self._reconfigs[1:]):
+            s_prev = set(prev.get("suspected") or [])
+            s_cur = set(cur.get("suspected") or [])
+            live_prev, live_cur = prev.get("live"), cur.get("live")
+            if live_prev is None or live_cur is None:
+                continue
+            if s_cur > s_prev and live_cur > live_prev:
+                v = self._emit(
+                    "non_monotone_reconfig",
+                    ("grow", prev.get("round"), cur.get("round")),
+                    f"suspicion grew {sorted(s_prev)} -> {sorted(s_cur)} "
+                    f"but the live quorum view grew {live_prev} -> "
+                    f"{live_cur} (round {prev.get('round')} -> "
+                    f"{cur.get('round')})",
+                    round=cur.get("round"),
+                )
+                if v:
+                    out.append(v)
+        return out
+
+    def _check_lineage(self) -> list[Violation]:
+        out: list[Violation] = []
+        delivered_digests: dict[tuple[int, int], set[str]] = {}
+        for _, sender, seq, digest, _, _ in self._delivers:
+            if digest:
+                delivered_digests.setdefault((sender, seq), set()).add(digest)
+        for r, trainer, digest in self._admits:
+            if not self._round_complete(r):
+                continue
+            if digest not in delivered_digests.get((trainer, r), ()):
+                v = self._emit(
+                    "tainted_digest",
+                    (r, trainer, digest),
+                    f"round {r} admitted trainer {trainer}'s digest "
+                    f"{digest[:12]} into aggregation without a matching "
+                    "BRB delivery",
+                    round=r,
+                )
+                if v:
+                    out.append(v)
+        return out
+
+    # ---- convenience ---------------------------------------------------------
+
+    def audit(self, events: Iterable[dict]) -> list[Violation]:
+        """Feed a whole stream, run the cross-event checks, and return every
+        violation found (the offline entry point)."""
+        for ev in events:
+            self.feed(ev)
+        self.check()
+        return list(self.violations)
+
+    def summary(self) -> dict[str, Any]:
+        by_invariant: dict[str, int] = {}
+        for v in self.violations:
+            by_invariant[v.invariant] = by_invariant.get(v.invariant, 0) + 1
+        return {
+            "violations": len(self.violations),
+            "by_invariant": dict(sorted(by_invariant.items())),
+        }
+
+
+def merge_streams(streams: list[list[dict]]) -> list[dict]:
+    """Deterministically merge N per-process event streams into one.
+
+    Sort key: ``(round, lamport, stream index, local n)`` — round groups
+    the protocol phases, the Lamport time orders causally-related events
+    within a round (a receive always sorts after its send), and the
+    (stream, n) tail breaks the remaining concurrency ties identically on
+    every run. The auditor's checks are order-insensitive; the merged order
+    exists so ``causal_digest`` is a stable cross-peer fingerprint.
+    """
+    keyed = []
+    for si, evs in enumerate(streams):
+        for ev in evs:
+            lamport = ev.get("lamport")
+            keyed.append(
+                (
+                    _round_of(ev),
+                    int(lamport) if isinstance(lamport, int) else -1,
+                    si,
+                    int(ev.get("n", 0)),
+                    ev,
+                )
+            )
+    keyed.sort(key=lambda t: t[:4])
+    return [t[4] for t in keyed]
+
+
+def causal_digest(events: Iterable[dict]) -> str:
+    """SHA-256 over the time-stripped merged stream — two same-seed runs
+    produce the same digest (the cross-peer bit-identity check)."""
+    h = hashlib.sha256()
+    for ev in events:
+        ev = {k: v for k, v in ev.items() if k != "ts"}
+        h.update(json.dumps(ev, sort_keys=True).encode())
+    return h.hexdigest()

@@ -42,6 +42,51 @@ DIGEST_LEN = 32
 
 _BATCH_KIND_CODE = {ECHO: 1, READY: 2}
 
+# Signed-header magics, one per revision of the batch signing encoding:
+# BRB2 is the fixed-width header without a trace tag, BRB3 appends the
+# emitter's (peer, local_seq, lamport) coordinates. Distinct magics keep
+# the two encodings injective against each other — a BRB3 byte string can
+# never verify as a BRB2 one (and p2plint's wire-kind registry check
+# enforces that no two revisions share a magic).
+_SIGNING_MAGIC_CODES = {b"BRB2": 2, b"BRB3": 3}
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceTag:
+    """Causal origin of one control message: which peer emitted it, its
+    per-peer emission counter, and the emitter's Lamport time at emission.
+
+    ``(peer, lseq)`` uniquely names the emission event process-wide;
+    ``lamport`` orders it against every causally-related event, so a
+    merged multi-peer event stream can reconstruct send->recv edges
+    without any wall clock (replay-exact by construction)."""
+
+    peer: int
+    lseq: int
+    lamport: int
+
+
+class LamportClock:
+    """Per-peer logical clock (Lamport 1978): ``tick()`` on every emission,
+    ``observe()`` (max-merge + 1) on every receipt. Purely logical — no
+    wall-clock reads — so clock values are bit-identical across same-seed
+    replays and never perturb protocol state."""
+
+    def __init__(self, peer: int) -> None:
+        self.peer = peer
+        self.time = 0
+        self._lseq = 0
+
+    def tick(self) -> TraceTag:
+        """Advance for a local emission; returns the message's trace tag."""
+        self.time += 1
+        self._lseq += 1
+        return TraceTag(self.peer, self._lseq, self.time)
+
+    def observe(self, lamport: int) -> None:
+        """Merge a received message's Lamport time (receive rule)."""
+        self.time = max(self.time, int(lamport)) + 1
+
 
 @dataclasses.dataclass(frozen=True)
 class BRBConfig:
@@ -74,6 +119,11 @@ class BRBMessage:
     digest: bytes
     payload: Optional[bytes] = None  # only on SEND
     signature: Optional[bytes] = None  # over signing_bytes(), except SEND payload sig
+    # Causal-trace header (wire v3). Unsigned on the per-message path so a
+    # v3 message verifies under the unchanged v1/v2 signing bytes — the
+    # trace is observability metadata, not a protocol input, and a
+    # stripped/forged tag can at worst mislabel a flight-recorder edge.
+    trace: Optional[TraceTag] = None
 
     def signing_bytes(self) -> bytes:
         return b"|".join(
@@ -106,6 +156,10 @@ class BRBBatch:
     seq: int  # broadcast sequence number (round index)
     items: tuple[tuple[int, bytes], ...]  # (sender, digest) per instance
     signature: Optional[bytes] = None  # over signing_bytes()
+    # Causal-trace header (wire v3). SIGNED on the batch path: the whole
+    # frame is one signature anyway, so covering the tag costs nothing and
+    # pins the emitter's claimed causal coordinates.
+    trace: Optional[TraceTag] = None
 
     def signing_bytes(self) -> bytes:
         # Injective, fixed-width encoding: every field has a known width and
@@ -114,14 +168,23 @@ class BRBBatch:
         # layout is NOT injective once variable-length digests sit next to
         # integer fields: adjacent votes can re-frame across the delimiter
         # and an honest signature would verify for a different vote list.)
+        # Traceless batches sign the BRB2 header, traced ones the BRB3
+        # header with the fixed-width trace coordinates appended; the
+        # distinct magics keep the two revisions mutually injective.
         code = _BATCH_KIND_CODE.get(self.kind)
         if code is None:
             raise ValueError(f"unsignable batch kind: {self.kind!r}")
-        parts = [
-            struct.pack(
+        if self.trace is None:
+            header = struct.pack(
                 ">4sBqqI", b"BRB2", code, self.from_id, self.seq, len(self.items)
             )
-        ]
+        else:
+            header = struct.pack(
+                ">4sBqqIqqq", b"BRB3", code, self.from_id, self.seq,
+                len(self.items), self.trace.peer, self.trace.lseq,
+                self.trace.lamport,
+            )
+        parts = [header]
         for sender, digest in self.items:
             if len(digest) != DIGEST_LEN:
                 raise ValueError(
@@ -162,11 +225,18 @@ class BRBInstance:
         sign_control: bool = True,
         sender: Optional[int] = None,
         seq: Optional[int] = None,
+        clock: Optional[LamportClock] = None,
     ) -> None:
         self.cfg = cfg
         self.my_id = my_id
         self.key_server = key_server
         self.private_key = private_key
+        # Causal clock: shared across a Broadcaster's instances (one clock
+        # per peer, the Lamport model); standalone instances get their own.
+        self.clock = clock if clock is not None else LamportClock(my_id)
+        # Trace tag of the message currently being processed — the *cause*
+        # of whatever this instance emits/records next (None at origin).
+        self._cause: Optional[str] = None
         # With control batching, this peer's echoes/readies only ever
         # travel inside a signed BRBBatch — the per-message signature would
         # be dead weight (and the dominant host cost), so it is skipped.
@@ -195,23 +265,40 @@ class BRBInstance:
         self._echo_at: Optional[float] = None
 
     def _flight(self, kind: str, **fields) -> None:
+        # Every event carries the peer's Lamport time plus the trace tag of
+        # the message that caused it ("peer:lamport" of the emission), so a
+        # merged multi-peer stream reconstructs send->recv edges offline.
         flight.record(
-            kind, sender=self.sender, seq=self.seq, peer=self.my_id, **fields
+            kind, sender=self.sender, seq=self.seq, peer=self.my_id,
+            lamport=self.clock.time, cause=self._cause, **fields,
         )
 
     def _make(self, kind: str, sender: int, seq: int, digest: bytes, payload=None) -> BRBMessage:
         telemetry.counter("brb.messages", kind=kind, dir="tx").inc()
-        msg = BRBMessage(kind, sender, seq, self.my_id, digest, payload)
+        trace = self.clock.tick()
+        msg = BRBMessage(kind, sender, seq, self.my_id, digest, payload, trace=trace)
         if kind != SEND and not self.sign_control:
             return msg  # valid only inside a signed BRBBatch
         return dataclasses.replace(
             msg, signature=crypto.sign_data(self.private_key, msg.signing_bytes())
         )
 
+    def _observe(self, msg: BRBMessage) -> None:
+        """Receive rule: merge the sender's Lamport time and remember the
+        message's trace tag as the cause of what this instance does next."""
+        if msg.trace is not None:
+            self.clock.observe(msg.trace.lamport)
+            self._cause = f"{msg.trace.peer}:{msg.trace.lamport}"
+        else:
+            self._cause = None
+
     def broadcast(self, seq: int, payload: bytes) -> list[BRBMessage]:
         """Originate: emit SEND to all (caller fans out)."""
         digest = hashlib.sha256(payload).digest()
-        return [self._make(SEND, self.my_id, seq, digest, payload)]
+        self._cause = None  # origin event: nothing caused it
+        msg = self._make(SEND, self.my_id, seq, digest, payload)
+        self._flight("brb_send", digest=digest.hex())
+        return [msg]
 
     def _try_deliver(self) -> None:
         if self.delivered is not None:
@@ -234,6 +321,7 @@ class BRBInstance:
                     votes=len(voters),
                     quorum=self.cfg.deliver_quorum,
                     margin=len(voters) - self.cfg.deliver_quorum,
+                    digest=digest.hex(),
                 )
                 return
 
@@ -255,6 +343,7 @@ class BRBInstance:
 
     def _advance(self, msg: BRBMessage) -> list[BRBMessage]:
         out: list[BRBMessage] = []
+        self._observe(msg)
 
         if msg.kind == SEND:
             if msg.from_id != msg.sender or msg.payload is None:
@@ -271,8 +360,9 @@ class BRBInstance:
             if self.accepted_digest == msg.digest and not self.sent_echo:
                 self.sent_echo = True
                 self._echo_at = time.perf_counter()
-                self._flight("brb_echo", digest=msg.digest.hex()[:12])
+                # _make first: the recorded lamport is the emission's time.
                 out.append(self._make(ECHO, msg.sender, msg.seq, msg.digest))
+                self._flight("brb_echo", digest=msg.digest.hex()[:12])
             # A late SEND can complete a delivery whose READY quorum for this
             # digest already formed (payload was the missing piece).
             self._try_deliver()
@@ -283,15 +373,20 @@ class BRBInstance:
             self._echo_voted.add(msg.from_id)
             voters = self.echoes.setdefault(msg.digest, set())
             voters.add(msg.from_id)
+            # One brb_vote per COUNTED vote (post-dedup): the conformance
+            # auditor recounts quorums and double votes from these.
+            self._flight(
+                "brb_vote", vote=ECHO, voter=msg.from_id, digest=msg.digest.hex()
+            )
             if len(voters) >= self.cfg.echo_quorum and not self.sent_ready:
                 self.sent_ready = True
+                out.append(self._make(READY, msg.sender, msg.seq, msg.digest))
                 self._flight(
                     "brb_ready",
                     via="echo",
                     votes=len(voters),
                     quorum=self.cfg.echo_quorum,
                 )
-                out.append(self._make(READY, msg.sender, msg.seq, msg.digest))
 
         elif msg.kind == READY:
             if msg.from_id in self._ready_voted:
@@ -299,15 +394,18 @@ class BRBInstance:
             self._ready_voted.add(msg.from_id)
             voters = self.readies.setdefault(msg.digest, set())
             voters.add(msg.from_id)
+            self._flight(
+                "brb_vote", vote=READY, voter=msg.from_id, digest=msg.digest.hex()
+            )
             if len(voters) >= self.cfg.ready_amplify and not self.sent_ready:
                 self.sent_ready = True
+                out.append(self._make(READY, msg.sender, msg.seq, msg.digest))
                 self._flight(
                     "brb_ready",
                     via="amplify",
                     votes=len(voters),
                     quorum=self.cfg.ready_amplify,
                 )
-                out.append(self._make(READY, msg.sender, msg.seq, msg.digest))
             self._try_deliver()
 
         return out
@@ -348,6 +446,10 @@ class Broadcaster:
         self.key_server = key_server
         self.private_key = private_key
         self.sign_control = sign_control
+        # One Lamport clock per peer, shared by every instance: causal
+        # order is a property of the peer's whole control plane, not of a
+        # single broadcast.
+        self.clock = LamportClock(my_id)
         self.instances: dict[tuple[int, int], BRBInstance] = {}
 
     def reconfigure(self, cfg: BRBConfig) -> None:
@@ -370,14 +472,19 @@ class Broadcaster:
                 sign_control=self.sign_control,
                 sender=sender,
                 seq=seq,
+                clock=self.clock,
             )
+            # Field name: "committee", NOT "n" — the recorder reserves "n"
+            # for its own monotone sequence number, and a caller field named
+            # "n" would silently overwrite it (dict update order).
             flight.record(
                 "brb_init",
                 sender=sender,
                 seq=seq,
                 peer=self.my_id,
-                n=self.cfg.n,
+                committee=self.cfg.n,
                 f=self.cfg.f,
+                lamport=self.clock.time,
             )
         return self.instances[key]
 
@@ -409,6 +516,7 @@ class Broadcaster:
             from_id=self.my_id,
             seq=seq,
             items=tuple((int(s), bytes(d)) for s, d in items),
+            trace=self.clock.tick(),
         )
         return dataclasses.replace(
             batch, signature=crypto.sign_data(self.private_key, batch.signing_bytes())
@@ -447,7 +555,12 @@ class Broadcaster:
             return []
         out: list[BRBMessage] = []
         for sender, digest in batch.items:
-            msg = BRBMessage(batch.kind, int(sender), batch.seq, batch.from_id, digest)
+            # Each unpacked vote carries the batch's trace tag: causally,
+            # every vote in the frame is one emission event of the sender.
+            msg = BRBMessage(
+                batch.kind, int(sender), batch.seq, batch.from_id, digest,
+                trace=batch.trace,
+            )
             out.extend(self._instance(int(sender), batch.seq).handle_preverified(msg))
         return out
 

@@ -31,7 +31,12 @@ import threading
 import time
 from typing import Callable, Optional
 
-from p2pdl_tpu.protocol.brb import BRBBatch, BRBMessage
+from p2pdl_tpu.protocol.brb import (
+    _SIGNING_MAGIC_CODES,
+    BRBBatch,
+    BRBMessage,
+    TraceTag,
+)
 from p2pdl_tpu.utils import telemetry
 
 Handler = Callable[[int, bytes], None]  # (src_id, data) -> None
@@ -42,7 +47,13 @@ Handler = Callable[[int, bytes], None]  # (src_id, data) -> None
 # instances under one signature. v1 messages remain valid in v2 — SENDs
 # always travel per-message — and a v1-only receiver ignores batch frames
 # (they lack the "sender"/"digest" keys, so brb_from_wire returns None).
-CONTROL_WIRE_VERSION = 2
+# v3 adds the optional causal-trace header: a "trace" key of
+# [peer, local_seq, lamport] on both frame shapes. Backward compatible in
+# both directions — older receivers ignore unknown JSON keys, and a
+# traceless frame parses here as trace=None (signing stays BRB2 for it).
+# The version number is the BRB3 signing-magic code: one source of truth
+# for "which header revision is current".
+CONTROL_WIRE_VERSION = _SIGNING_MAGIC_CODES[b"BRB3"]
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 1 << 30
@@ -85,6 +96,17 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return bytes(buf)
 
 
+def _trace_to_wire(trace: Optional[TraceTag]):
+    return None if trace is None else [trace.peer, trace.lseq, trace.lamport]
+
+
+def _trace_from_wire(raw) -> Optional[TraceTag]:
+    if raw is None:
+        return None
+    peer, lseq, lamport = raw
+    return TraceTag(int(peer), int(lseq), int(lamport))
+
+
 def brb_to_wire(msg: BRBMessage) -> bytes:
     def b64(x):
         return base64.b64encode(x).decode() if x is not None else None
@@ -98,6 +120,7 @@ def brb_to_wire(msg: BRBMessage) -> bytes:
             "digest": b64(msg.digest),
             "payload": b64(msg.payload),
             "signature": b64(msg.signature),
+            "trace": _trace_to_wire(msg.trace),
         }
     ).encode()
 
@@ -119,6 +142,7 @@ def brb_from_wire(data: bytes) -> Optional[BRBMessage]:
             digest=unb64(d["digest"]),
             payload=unb64(d.get("payload")),
             signature=unb64(d.get("signature")),
+            trace=_trace_from_wire(d.get("trace")),
         )
     except (ValueError, KeyError, TypeError):
         return None
@@ -137,6 +161,7 @@ def batch_to_wire(batch: BRBBatch) -> bytes:
             "seq": batch.seq,
             "items": [[s, b64(d)] for s, d in batch.items],
             "signature": b64(batch.signature),
+            "trace": _trace_to_wire(batch.trace),
         }
     ).encode()
 
@@ -157,6 +182,7 @@ def control_from_wire(data: bytes):
                 (int(s), base64.b64decode(dg)) for s, dg in d["items"]
             ),
             signature=base64.b64decode(sig) if sig is not None else None,
+            trace=_trace_from_wire(d.get("trace")),
         )
     except (ValueError, KeyError, TypeError):
         return None

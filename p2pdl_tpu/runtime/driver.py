@@ -19,6 +19,7 @@ delivery failures are recorded rather than hanging the experiment.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 import threading
@@ -397,6 +398,15 @@ class _TrustPlane:
                 for pid in live_peers
             ):
                 verified.append(tid)
+                # Digest-lineage taint rule: everything the aggregate admits
+                # leaves an agg_admit event whose digest the auditor matches
+                # against a brb_deliver for the same (trainer, round).
+                flight.record(
+                    "agg_admit",
+                    round=round_idx,
+                    trainer=tid,
+                    digest=hashlib.sha256(expected).hexdigest(),
+                )
         # Per-instance quorum margins and delivery latencies for the round's
         # health summary: margin = ready votes beyond the delivery quorum on
         # the digest that actually delivered (0 = delivered with zero slack).
@@ -445,6 +455,7 @@ class Experiment:
         fault_plan: Optional[Any] = None,
         pipeline: bool = True,
         perf: bool = False,
+        audit: bool = False,
     ) -> None:
         self.cfg = cfg
         self.attack = attack
@@ -573,6 +584,20 @@ class Experiment:
         self.cost_model = (
             devprof.CostModel(n_devices=self.mesh.devices.size) if perf else None
         )
+        # Conformance auditor (opt-in, ``audit=True`` / ``cli run --audit``):
+        # re-checks the BRB safety / quorum / digest-lineage invariants over
+        # the live flight stream once per round. It consumes the event ring,
+        # so turning it on force-enables recording; honest runs report
+        # nothing, which keeps the RoundRecord stream bit-identical with the
+        # auditor off (violations are anomalies, and anomalies are counted
+        # unconditionally either way).
+        self.auditor = None
+        self._audit_cursor = 0
+        if audit:
+            from p2pdl_tpu.protocol.audit import ProtocolAuditor
+
+            flight.set_enabled(True)
+            self.auditor = ProtocolAuditor(registered=range(cfg.num_peers))
         for fn in (
             self.round_fn,
             getattr(self, "train_fn", None),
@@ -1113,6 +1138,11 @@ class Experiment:
         # an unexpected compile lands in this round's protocol_health
         # anomaly delta as well as the flight ring + recompiles counter.
         self.sentinel.check(r)
+        # Live conformance audit: runs INSIDE the anomaly watermark like the
+        # sentinel, so a violated invariant lands in this round's
+        # protocol_health anomaly delta as well as the flight ring.
+        if self.auditor is not None:
+            self._audit_round(r)
         # Per-round protocol health: deterministic quorum facts plus the
         # flight recorder's anomaly delta (unconditional counting, so the
         # record is identical with the recorder on or off), plus wall-clock
@@ -1171,6 +1201,26 @@ class Experiment:
         if boundary:
             self.checkpointer.save(self.state, self.cfg, extra=self._ckpt_extra)
         return record
+
+    def _audit_round(self, r: int) -> None:
+        """Feed the flight events recorded since the last audit into the
+        conformance auditor; new violations surface as ``audit_violation``
+        flight anomalies and a per-invariant counter. The cursor tails the
+        ring (``events_page``), so each event is audited exactly once."""
+        page = flight.recorder().events_page(since=self._audit_cursor)
+        new = []
+        for ev in page["events"]:
+            new.extend(self.auditor.feed(ev))
+        self._audit_cursor = page["next_cursor"]
+        new.extend(self.auditor.check())
+        for v in new:
+            flight.anomaly(
+                "audit_violation",
+                invariant=v.invariant,
+                detail=v.detail,
+                round=r,
+            )
+            telemetry.counter("audit.violations", invariant=v.invariant).inc()
 
     def _flush_pending_round(self) -> Optional[RoundRecord]:
         """Resolve the deferred readbacks of the previously dispatched

@@ -36,6 +36,35 @@ from p2pdl_tpu.utils import flight, telemetry
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+# /flight paging: default and hard page caps for cursor scrapes, so a live
+# tail never re-ships the whole ring (and a hostile ?limit can't either).
+FLIGHT_PAGE_LIMIT = 512
+FLIGHT_PAGE_LIMIT_MAX = 2048
+
+
+def _flight_page_params(
+    query: str,
+) -> tuple[Optional[dict[str, int]], Optional[str]]:
+    """Parse ``since``/``limit`` from a /flight query string; returns
+    ``(params, None)`` or ``(None, error)`` — the PR 6 error matrix says a
+    bad request gets a JSON body naming the problem, not a silent default."""
+    params = {"since": 0, "limit": FLIGHT_PAGE_LIMIT}
+    for part in query.split("&"):
+        if not part:
+            continue
+        key, sep, raw = part.partition("=")
+        if key not in params or not sep:
+            return None, f"unknown /flight query parameter: {part!r}"
+        try:
+            val = int(raw)
+        except ValueError:
+            return None, f"/flight ?{key} must be a non-negative integer, got {raw!r}"
+        if val < 0:
+            return None, f"/flight ?{key} must be a non-negative integer, got {raw!r}"
+        params[key] = val
+    params["limit"] = min(params["limit"], FLIGHT_PAGE_LIMIT_MAX)
+    return params, None
+
 
 class OrchestratorState:
     def __init__(self, cfg: Config, **experiment_kwargs) -> None:
@@ -93,6 +122,7 @@ def _observability_get(
 ) -> Optional[tuple[int, str, bytes]]:
     """Route the shared observability GETs; returns ``(status, content_type,
     body)`` or None when ``path`` is not an observability endpoint."""
+    path, _, query = path.partition("?")
     if path == "/metrics":
         body = telemetry.render_prometheus(snapshot_fn()).encode()
         return 200, PROMETHEUS_CONTENT_TYPE, body
@@ -108,6 +138,19 @@ def _observability_get(
         return 200, "application/json", json.dumps(payload).encode()
     if path == "/flight":
         rec = flight.recorder()
+        if query:
+            # Cursor-paged tail: ?since=<n> resumes where the last scrape
+            # stopped, ?limit bounds the page (default FLIGHT_PAGE_LIMIT,
+            # hard cap FLIGHT_PAGE_LIMIT_MAX) — live tailing without
+            # re-shipping the whole ring each scrape.
+            params, err = _flight_page_params(query)
+            if err is not None:
+                return 400, "application/json", json.dumps({"error": err}).encode()
+            payload = rec.events_page(
+                since=params["since"], limit=params["limit"], strip_time=True
+            )
+            payload["summary"] = rec.summary()
+            return 200, "application/json", json.dumps(payload).encode()
         payload = {
             "summary": rec.summary(),
             "events": rec.events(strip_time=True),

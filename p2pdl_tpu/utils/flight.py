@@ -58,10 +58,18 @@ DEFAULT_CAPACITY = 4096
 
 # The anomaly kinds that trigger dump-on-anomaly. Everything here is an
 # invariant violation, not a routine transition: protocol health
-# (delivery timeout, rejected batch frame, live-quorum collapse) plus the
+# (delivery timeout, rejected batch frame, live-quorum collapse), the
 # performance plane's `recompile` (a compiled program re-traced after its
-# expected compiles — the static-shape discipline broke somewhere).
-ANOMALY_KINDS = ("brb_timeout", "batch_rejected", "quorum_collapse", "recompile")
+# expected compiles — the static-shape discipline broke somewhere), and
+# the conformance auditor's `audit_violation` (a BRB safety / quorum /
+# digest-lineage invariant failed on the live event stream).
+ANOMALY_KINDS = (
+    "brb_timeout",
+    "batch_rejected",
+    "quorum_collapse",
+    "recompile",
+    "audit_violation",
+)
 
 
 class FlightRecorder:
@@ -107,8 +115,12 @@ class FlightRecorder:
         if not self.enabled:
             return
         with self._lock:
-            ev = {"n": self._seq, "kind": kind, "ts": time.perf_counter()}
-            ev.update(fields)
+            # Reserved keys win over caller fields: a field named "n"/"ts"
+            # must not clobber the sequence number or the clock stamp.
+            ev = dict(fields)
+            ev["n"] = self._seq
+            ev["kind"] = kind
+            ev["ts"] = time.perf_counter()
             self._seq += 1
             self._ring.append(ev)
 
@@ -149,6 +161,35 @@ class FlightRecorder:
             for ev in evs:
                 ev.pop("ts", None)
         return evs
+
+    def events_page(
+        self,
+        since: int = 0,
+        limit: Optional[int] = None,
+        strip_time: bool = False,
+    ) -> dict[str, Any]:
+        """Cursor-paged view of the ring for live tailing: events with
+        ``n >= since``, oldest first, at most ``limit`` of them.
+
+        Returns ``{"events", "next_cursor", "events_recorded"}`` —
+        ``next_cursor`` is the ``since`` that continues the tail (one past
+        the last returned event, or the current sequence head when the
+        page is empty), and ``events_recorded`` lets the caller detect a
+        cursor that fell off the ring (missed history)."""
+        with self._lock:
+            evs = [dict(ev) for ev in self._ring if ev["n"] >= since]
+            head = self._seq
+        if limit is not None:
+            evs = evs[: max(0, limit)]
+        if strip_time:
+            for ev in evs:
+                ev.pop("ts", None)
+        next_cursor = (evs[-1]["n"] + 1) if evs else head
+        return {
+            "events": evs,
+            "next_cursor": next_cursor,
+            "events_recorded": head,
+        }
 
     def instance_timelines(self) -> dict[str, list[dict[str, Any]]]:
         """Per-BRB-instance event timelines keyed ``"sender:seq"``.

@@ -1,0 +1,499 @@
+"""Causal tracing + protocol conformance auditor.
+
+Four contracts pinned here:
+
+- **Causal coordinates**: every control message carries a wire-v3 Lamport
+  trace header (backward compatible: v1/v2 parsers ignore it, untraced
+  frames still parse), clocks merge on receive, and every ``brb_*`` flight
+  event carries ``(peer, lamport, cause)`` so send→recv edges are
+  reconstructible from the stream alone.
+- **Auditor soundness**: the honest trust-plane round audits clean, and
+  each seeded invariant violation (the known-bad matrix) drives
+  ``cli audit`` to exit 1 naming the violated invariant.
+- **Cross-peer determinism**: two same-seed runs produce identical
+  time-stripped merged causal digests (``merge_streams`` +
+  ``causal_digest``).
+- **Neutrality**: the live auditor changes no protocol outcome — the
+  RoundRecord stream is bit-identical with ``audit=True`` vs off (SPMD).
+"""
+
+import copy
+import hashlib
+import json
+
+import jax
+import pytest
+
+from p2pdl_tpu.cli import main as cli_main
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.protocol.audit import (
+    INVARIANTS,
+    ProtocolAuditor,
+    causal_digest,
+    merge_streams,
+)
+from p2pdl_tpu.protocol.brb import LamportClock, TraceTag
+from p2pdl_tpu.utils import flight
+
+requires_spmd = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="driver needs jax.shard_map (set P2PDL_JAX_COMPAT=1 for the shims)",
+)
+
+
+# ------------------------------------------------------ Lamport clocks
+
+
+def test_lamport_tick_is_monotone_and_sequenced():
+    clk = LamportClock(peer=3)
+    a, b = clk.tick(), clk.tick()
+    assert (a.peer, a.lseq, a.lamport) == (3, 1, 1)
+    assert (b.peer, b.lseq, b.lamport) == (3, 2, 2)
+
+
+def test_lamport_observe_merges_to_max_plus_one():
+    clk = LamportClock(peer=0)
+    clk.tick()
+    clk.observe(10)
+    assert clk.time == 11
+    clk.observe(4)  # behind: still advances past local time
+    assert clk.time == 12
+    t = clk.tick()
+    assert t.lamport == 13 and t.lseq == 2  # lseq counts local emissions only
+
+
+def test_wire_v3_trace_header_roundtrip_and_backcompat():
+    from p2pdl_tpu.protocol.brb import BRBMessage
+    from p2pdl_tpu.protocol.transport import (
+        CONTROL_WIRE_VERSION,
+        brb_to_wire,
+        control_from_wire,
+    )
+
+    assert CONTROL_WIRE_VERSION == 3
+    digest = hashlib.sha256(b"p").digest()
+    traced = BRBMessage(
+        "send", 1, 0, 1, digest, b"p", trace=TraceTag(1, 1, 7)
+    )
+    assert control_from_wire(brb_to_wire(traced)) == traced
+    # Old frames have no "trace" key: parses with trace=None (v1/v2 compat),
+    # and a traced frame minus its header is still a valid untraced frame.
+    doc = json.loads(brb_to_wire(traced))
+    assert doc["trace"] == [1, 1, 7]
+    del doc["trace"]
+    parsed = control_from_wire(json.dumps(doc).encode())
+    assert parsed is not None and parsed.trace is None
+
+
+# ---------------------------------------------- honest probe stream
+
+
+def _probe_events(round_idx: int = 0):
+    """One honest committee BRB round on the host hub, flight-recorded —
+    the clean stream every audit check below starts from."""
+    from p2pdl_tpu.runtime.driver import _TrustPlane
+
+    prior = flight.enabled()
+    try:
+        flight.set_enabled(True)
+        flight.reset()
+        cfg = Config(num_peers=8, trainers_per_round=3, byzantine_f=1)
+        trainers = [0, 3, 5]
+        plane = _TrustPlane(cfg)
+        digests = {t: hashlib.sha256(b"probe-%d" % t).digest() for t in trainers}
+        flight.record(
+            "round_begin", round=round_idx, trainers=trainers, suspected=[]
+        )
+        plane.run_round(round_idx, trainers, digests)
+        return flight.recorder().events(strip_time=True)
+    finally:
+        flight.reset()
+        flight.set_enabled(prior)
+
+
+@pytest.fixture(scope="module")
+def probe():
+    return _probe_events()
+
+
+def test_probe_events_carry_causal_coordinates(probe):
+    brb = [ev for ev in probe if ev["kind"].startswith("brb_")]
+    assert brb, "probe produced no brb events"
+    assert all("peer" in ev and "lamport" in ev for ev in brb)
+    # Origin sends are uncaused; every reaction names its causing emission
+    # as "peer:lamport" — the send→recv edge.
+    sends = [ev for ev in brb if ev["kind"] == "brb_send"]
+    votes = [ev for ev in brb if ev["kind"] == "brb_vote"]
+    assert sends and all(ev["cause"] is None for ev in sends)
+    assert votes and all(ev["cause"] for ev in votes)
+    for ev in votes:
+        peer, lamport = ev["cause"].split(":")
+        # A receive's clock always runs ahead of its cause (Lamport order).
+        assert ev["lamport"] > int(lamport)
+
+
+def test_agg_admit_lineage_present(probe):
+    admits = [ev for ev in probe if ev["kind"] == "agg_admit"]
+    delivers = {
+        (ev["sender"], ev["seq"], ev["digest"])
+        for ev in probe
+        if ev["kind"] == "brb_deliver"
+    }
+    assert {ev["trainer"] for ev in admits} == {0, 3, 5}
+    for ev in admits:
+        assert (ev["trainer"], ev["round"], ev["digest"]) in delivers
+
+
+def test_honest_round_audits_clean(probe):
+    auditor = ProtocolAuditor(registered=range(8))
+    assert auditor.audit(probe) == []
+    assert auditor.summary() == {"violations": 0, "by_invariant": {}}
+    # check() is idempotent: re-running reports nothing new.
+    assert auditor.check() == []
+
+
+def test_merged_causal_digest_is_same_seed_bit_identical(probe):
+    again = _probe_events()
+    assert causal_digest(merge_streams([probe])) == causal_digest(
+        merge_streams([again])
+    )
+    # Splitting one run's stream across two "processes" and merging keeps
+    # determinism too (the multihost dump-per-peer shape).
+    half = len(probe) // 2
+    split = merge_streams([probe[:half], probe[half:]])
+    split_again = merge_streams([again[:half], again[half:]])
+    assert causal_digest(split) == causal_digest(split_again)
+
+
+def test_merge_streams_orders_receives_after_their_cause(probe):
+    merged = merge_streams([probe])
+    pos = {ev["n"]: i for i, ev in enumerate(merged)}
+    send_at = {
+        (ev["sender"], ev["seq"]): i
+        for i, ev in enumerate(merged)
+        if ev["kind"] == "brb_send"
+    }
+    for i, ev in enumerate(merged):
+        if ev["kind"] == "brb_deliver":
+            assert i > send_at[(ev["sender"], ev["seq"])]
+    assert len(pos) == len(merged)  # n unique across one stream
+
+
+# ------------------------------------------- known-bad matrix (cli audit)
+
+
+def _mutate_conflicting_deliver(evs):
+    d = [e for e in evs if e["kind"] == "brb_deliver"][3]
+    d["digest"] = "ff" * 32
+
+
+def _mutate_forged_quorum(evs):
+    d = [e for e in evs if e["kind"] == "brb_deliver"][0]
+    d["votes"] = 1
+
+
+def _mutate_double_vote(evs):
+    v = [e for e in evs if e["kind"] == "brb_vote"][0]
+    evs.append(dict(v, n=evs[-1]["n"] + 1))
+
+
+def _mutate_unregistered_voter(evs):
+    v = [e for e in evs if e["kind"] == "brb_vote"][0]
+    v["voter"] = 99
+
+
+def _mutate_non_monotone_reconfig(evs):
+    n = evs[-1]["n"]
+    evs.append({
+        "n": n + 1, "kind": "quorum_reconfig", "round": 0,
+        "live": 6, "committee": 8, "f": 1, "suspected": [1, 2],
+    })
+    evs.append({
+        "n": n + 2, "kind": "quorum_reconfig", "round": 0,
+        "live": 7, "committee": 8, "f": 1, "suspected": [1, 2, 4],
+    })
+
+
+def _mutate_tainted_digest(evs):
+    a = [e for e in evs if e["kind"] == "agg_admit"][0]
+    a["digest"] = "ee" * 32
+
+
+_MUTATORS = {
+    "conflicting_deliver": _mutate_conflicting_deliver,
+    "forged_quorum": _mutate_forged_quorum,
+    "double_vote": _mutate_double_vote,
+    "unregistered_voter": _mutate_unregistered_voter,
+    "non_monotone_reconfig": _mutate_non_monotone_reconfig,
+    "tainted_digest": _mutate_tainted_digest,
+}
+
+
+def test_known_bad_matrix_covers_every_invariant():
+    assert set(_MUTATORS) == set(INVARIANTS)
+
+
+@pytest.mark.parametrize("invariant", sorted(_MUTATORS))
+def test_cli_audit_exits_nonzero_naming_the_invariant(
+    probe, invariant, tmp_path, capsys
+):
+    evs = copy.deepcopy(probe)
+    _MUTATORS[invariant](evs)
+    path = tmp_path / "bad.jsonl"
+    path.write_text(
+        "".join(json.dumps(ev, sort_keys=True) + "\n" for ev in evs)
+    )
+    assert cli_main(["audit", "--inputs", str(path)]) == 1
+    out = capsys.readouterr().out
+    assert f"[{invariant}]" in out
+    assert "audit FAILED" in out
+
+
+def test_cli_audit_clean_stream_exits_zero(probe, tmp_path, capsys):
+    path = tmp_path / "clean.jsonl"
+    path.write_text(
+        "".join(json.dumps(ev, sort_keys=True) + "\n" for ev in probe)
+    )
+    assert cli_main(["audit", "--inputs", str(path), "--registered-peers", "8"]) == 0
+    assert "audit clean" in capsys.readouterr().out
+
+
+def test_cli_audit_json_output_carries_digest_and_violations(
+    probe, tmp_path, capsys
+):
+    evs = copy.deepcopy(probe)
+    _mutate_tainted_digest(evs)
+    path = tmp_path / "bad.jsonl"
+    path.write_text(
+        "".join(json.dumps(ev, sort_keys=True) + "\n" for ev in evs)
+    )
+    assert cli_main(["audit", "--inputs", str(path), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["events"] == len(evs)
+    assert doc["summary"]["by_invariant"] == {"tainted_digest": 1}
+    (v,) = doc["violations"]
+    assert v["invariant"] == "tainted_digest" and v["round"] == 0
+    assert len(doc["causal_digest"]) == 64
+
+
+def test_cli_audit_usage_and_load_errors(tmp_path, capsys):
+    assert cli_main(["audit"]) == 2
+    assert "needs --inputs" in capsys.readouterr().err
+    assert cli_main(["audit", "--inputs", str(tmp_path / "missing.jsonl")]) == 2
+
+
+def test_cli_audit_scrapes_live_flight_endpoint(probe, capsys):
+    import threading
+    import urllib.request
+
+    from p2pdl_tpu.runtime.server import serve_metrics
+
+    prior = flight.enabled()
+    try:
+        flight.set_enabled(True)
+        flight.reset()
+        rec = flight.recorder()
+        for ev in probe:
+            fields = {
+                k: v for k, v in ev.items() if k not in ("n", "kind")
+            }
+            rec.record(ev["kind"], **fields)
+        server = serve_metrics(port=0)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            # Sanity: the endpoint answers before the auditor scrapes it.
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            ) as resp:
+                assert resp.status == 200
+            assert cli_main(["audit", "--inputs", f"http://127.0.0.1:{port}"]) == 0
+            assert "audit clean" in capsys.readouterr().out
+        finally:
+            server.shutdown()
+            server.server_close()
+    finally:
+        flight.reset()
+        flight.set_enabled(prior)
+
+
+# ---------------------------------------------- /flight cursor paging (S1)
+
+
+def test_events_page_cursor_and_bounds():
+    from p2pdl_tpu.utils.flight import FlightRecorder
+
+    rec = FlightRecorder(capacity=8, enabled=True)
+    for i in range(12):
+        rec.record("tick", i=i)
+    page = rec.events_page(since=0, limit=3, strip_time=True)
+    # Ring evicted n<4: the first page starts at the oldest retained event.
+    assert [ev["n"] for ev in page["events"]] == [4, 5, 6]
+    assert page["next_cursor"] == 7
+    assert page["events_recorded"] == 12
+    assert all("ts" not in ev for ev in page["events"])
+    tail = rec.events_page(since=page["next_cursor"])
+    assert [ev["n"] for ev in tail["events"]] == [7, 8, 9, 10, 11]
+    empty = rec.events_page(since=tail["next_cursor"])
+    assert empty["events"] == [] and empty["next_cursor"] == 12
+
+
+def test_flight_endpoint_cursor_paging_and_error_matrix():
+    from p2pdl_tpu.runtime.server import _observability_get
+    from p2pdl_tpu.utils import telemetry
+
+    prior = flight.enabled()
+    try:
+        flight.set_enabled(True)
+        flight.reset()
+        for i in range(10):
+            flight.record("tick", i=i)
+
+        def get(path):
+            status, ctype, body = _observability_get(path, telemetry.snapshot)
+            assert ctype == "application/json"
+            return status, json.loads(body)
+
+        # Bare /flight keeps the PR 6 shape: summary + whole stripped ring.
+        status, doc = get("/flight")
+        assert status == 200
+        assert "summary" in doc and len(doc["events"]) == 10
+        status, doc = get("/flight?since=3&limit=4")
+        assert status == 200
+        assert [ev["n"] for ev in doc["events"]] == [3, 4, 5, 6]
+        assert doc["next_cursor"] == 7 and doc["events_recorded"] == 10
+        status, doc = get(f"/flight?since={doc['next_cursor']}")
+        assert [ev["n"] for ev in doc["events"]] == [7, 8, 9]
+        # Error matrix: bad cursors answer 400 with a JSON error body.
+        for bad in ("/flight?since=abc", "/flight?since=-1", "/flight?bogus=1"):
+            status, doc = get(bad)
+            assert status == 400 and "error" in doc, bad
+    finally:
+        flight.reset()
+        flight.set_enabled(prior)
+
+
+def test_flight_page_limit_is_hard_capped():
+    from p2pdl_tpu.runtime.server import (
+        FLIGHT_PAGE_LIMIT_MAX,
+        _flight_page_params,
+    )
+
+    params, err = _flight_page_params("since=2&limit=999999")
+    assert err is None
+    assert params == {"since": 2, "limit": FLIGHT_PAGE_LIMIT_MAX}
+
+
+# -------------------------------------------- report warnings (S2)
+
+
+def test_report_surfaces_series_dropped_warning():
+    from p2pdl_tpu.cli import build_report_data, render_report
+
+    snap = {
+        "counters": {
+            "telemetry.series_dropped{metric=chaos.suspected}": 7.0,
+            "brb.delivered": 3.0,
+        }
+    }
+    data = build_report_data([], telemetry_snapshot=snap)
+    (warning,) = data["warnings"]
+    assert "chaos.suspected" in warning and "7" in warning
+    text = render_report([], telemetry_snapshot=snap)
+    assert "WARNING:" in text and "chaos.suspected" in text
+    # No fold, no warning block.
+    clean = build_report_data([], telemetry_snapshot={"counters": {"a": 1.0}})
+    assert "warnings" not in clean
+    assert "WARNING:" not in render_report(
+        [], telemetry_snapshot={"counters": {"a": 1.0}}
+    )
+
+
+# ------------------------------------ live driver audit (SPMD end-to-end)
+
+
+@pytest.fixture(scope="module")
+def audit_cfg():
+    # Mirrors test_chaos's chaos_cfg so the compile cache is shared.
+    return Config(
+        num_peers=8,
+        trainers_per_round=3,
+        rounds=4,
+        local_epochs=1,
+        samples_per_peer=32,
+        batch_size=32,
+        lr=0.05,
+        server_lr=1.0,
+        brb_enabled=True,
+        aggregator="secure_fedavg",
+    )
+
+
+def _stripped(records):
+    out = []
+    for rec in records:
+        d = rec.to_dict()
+        d.pop("duration_s")
+        if d.get("protocol_health"):
+            d["protocol_health"] = {
+                k: v
+                for k, v in d["protocol_health"].items()
+                if k != "brb_latency_s"
+            }
+        out.append(d)
+    return out
+
+
+@pytest.mark.chaos
+@requires_spmd
+def test_round_records_bit_identical_with_auditor_on_vs_off(audit_cfg, mesh8):
+    from p2pdl_tpu.runtime.driver import Experiment
+
+    def run(audit):
+        flight.reset()
+        flight.set_enabled(True)
+        exp = Experiment(
+            audit_cfg, fault_plan="crash_drop_partition", audit=audit
+        )
+        exp.run()
+        violations = flight.recorder().anomalies_by_kind.get(
+            "audit_violation", 0
+        )
+        return _stripped(exp.records), violations
+
+    prior = flight.enabled()
+    try:
+        on, v_on = run(True)
+        off, v_off = run(False)
+    finally:
+        flight.reset()
+        flight.set_enabled(prior)
+    assert v_on == 0 and v_off == 0  # honest chaos run: no violations
+    assert on == off
+
+
+@pytest.mark.chaos
+@requires_spmd
+def test_chaos_acceptance_run_audits_clean_offline(audit_cfg, mesh8, tmp_path, capsys):
+    """The tier-1 audit gate (mirrors test_lint_gate): the chaos acceptance
+    scenario's flight dump must pass the offline auditor."""
+    from p2pdl_tpu.runtime.driver import Experiment
+
+    prior = flight.enabled()
+    dump = tmp_path / "flight.jsonl"
+    try:
+        flight.reset()
+        flight.set_enabled(True)
+        exp = Experiment(audit_cfg, fault_plan="crash_drop_partition")
+        exp.run()
+        flight.dump(str(dump))
+    finally:
+        flight.reset()
+        flight.set_enabled(prior)
+    rc = cli_main([
+        "audit", "--inputs", str(dump),
+        "--registered-peers", str(audit_cfg.num_peers),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "audit clean" in out

@@ -467,6 +467,89 @@ def test_str_encode_field_flagged_without_join():
     assert "variable-width" in findings[0].message
 
 
+def test_fstring_encode_field_flagged_in_signing():
+    findings = lint(
+        """
+        import struct
+
+        def signing_bytes(self):
+            return struct.pack(">I", self.seq) + f"{self.sender}".encode()
+        """,
+        "protocol/fake.py",
+    )
+    assert rules_of(findings) == {"wire-signing"}
+    assert "f-string" in findings[0].message
+
+
+def test_json_dumps_encode_field_flagged_in_signing():
+    findings = lint(
+        """
+        import json
+
+        def signing_bytes(self):
+            return json.dumps({"seq": self.seq}).encode()
+        """,
+        "protocol/fake.py",
+    )
+    assert rules_of(findings) == {"wire-signing"}
+    assert "not canonical" in findings[0].message
+
+
+# The wire-v3 trace header pattern: a versioned signing builder packs one
+# header per revision; the magics are what keep the revisions mutually
+# injective, so a shared magic over two layouts is a forgery surface.
+VERSIONED_SIGNING = """
+    import struct
+
+    class BRBBatch:
+        def signing_bytes(self):
+            if self.trace is None:
+                head = struct.pack(
+                    ">4sBqqI", {magic_v2!r}, self.code, self.from_id,
+                    self.seq, len(self.items)
+                )
+            else:
+                head = struct.pack(
+                    ">4sBqqIqqq", {magic_v3!r}, self.code, self.from_id,
+                    self.seq, len(self.items), self.trace.peer,
+                    self.trace.lseq, self.trace.lamport
+                )
+            parts = [head]
+            for sender, digest in self.items:
+                parts.append(struct.pack(">q", sender))
+                parts.append(digest)
+            return b"".join(parts)
+    """
+
+
+def test_versioned_signing_with_distinct_magics_is_clean():
+    src = VERSIONED_SIGNING.format(magic_v2=b"BRB2", magic_v3=b"BRB3")
+    assert lint(src, "protocol/brb.py") == []
+
+
+def test_versioned_signing_sharing_one_magic_flagged():
+    src = VERSIONED_SIGNING.format(magic_v2=b"BRB2", magic_v3=b"BRB2")
+    findings = lint(src, "protocol/brb.py")
+    assert rules_of(findings) == {"wire-signing"}
+    assert "one magic" in findings[0].message
+
+
+def test_trace_magic_registry_duplicate_code_flagged():
+    # The v3 trace-header magics live in a kind-code registry; two magics
+    # mapping to one wire version number must be flagged like any other
+    # duplicate code.
+    findings = lint(
+        """
+        _SIGNING_MAGIC_CODES = {b"BRB2": 2, b"BRB3": 2}
+        """,
+        "protocol/brb.py",
+    )
+    assert rules_of(findings) == {"wire-kind-dup"}
+    assert lint(
+        '_SIGNING_MAGIC_CODES = {b"BRB2": 2, b"BRB3": 3}\n', "protocol/brb.py"
+    ) == []
+
+
 # ---- suppressions -----------------------------------------------------------
 
 

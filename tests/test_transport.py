@@ -289,3 +289,35 @@ def test_tcp_send_recovers_on_retry_when_listener_appears():
         t.stop()
         srv.stop()
         telemetry.reset()
+
+
+def test_batch_trace_header_roundtrips_and_is_signed():
+    """Wire v3: the batch trace tag survives the wire, is covered by the
+    signature (tagged vs untagged signing bytes differ), and a v2 parser
+    that drops the unknown key still gets the same votes back."""
+    import json
+
+    from p2pdl_tpu.protocol.brb import BRBBatch, TraceTag
+    from p2pdl_tpu.protocol.transport import batch_to_wire, control_from_wire
+
+    batch = BRBBatch(
+        kind="echo",
+        from_id=2,
+        seq=5,
+        items=((0, b"\x01" * 32), (3, b"\x02" * 32)),
+        trace=TraceTag(peer=2, lseq=4, lamport=9),
+    )
+    back = control_from_wire(batch_to_wire(batch))
+    assert back.trace == TraceTag(peer=2, lseq=4, lamport=9)
+    assert back.items == batch.items
+    assert back.signing_bytes() == batch.signing_bytes()
+
+    bare = BRBBatch(kind="echo", from_id=2, seq=5, items=batch.items)
+    assert batch.signing_bytes() != bare.signing_bytes()
+
+    doc = json.loads(batch_to_wire(batch))
+    del doc["trace"]
+    legacy = control_from_wire(json.dumps(doc).encode())
+    assert legacy is not None and legacy.trace is None
+    assert legacy.items == batch.items
+    assert legacy.signing_bytes() == bare.signing_bytes()
