@@ -70,9 +70,24 @@ def _env_float(name: str, default: float) -> float:
 PROBE_TIMEOUT_S = _env_float("P2PDL_BENCH_PROBE_TIMEOUT", 180.0)
 
 # Per-attempt probe outcomes, in order, across every probe_backend() call
-# this process made — attached to unreachable records so a dead run says
-# exactly how it died (N timeouts at M seconds vs. instant import errors).
-_PROBE_DIAGNOSTICS: list = []
+# this process made — attached to unreachable records AND to the success
+# headline's tail, so a dead run says exactly how it died (N timeouts at
+# M seconds vs. instant import errors) and a degraded CPU-fallback run
+# says exactly what it fell back FROM. Seeded from the env on re-exec:
+# the CPU-fallback execvpe would otherwise lose the accelerator probe's
+# forensics with the process image.
+_PROBE_DIAG_ENV = "P2PDL_BENCH_PROBE_DIAGNOSTICS"
+
+
+def _diags_from_env() -> list:
+    try:
+        loaded = json.loads(os.environ.get(_PROBE_DIAG_ENV, "[]"))
+        return loaded if isinstance(loaded, list) else []
+    except ValueError:
+        return []
+
+
+_PROBE_DIAGNOSTICS: list = _diags_from_env()
 
 # Artifact paths (defined before the early gate: the unreachable-record
 # path reads the stages file for provenance before any jax import).
@@ -217,6 +232,9 @@ if __name__ == "__main__" and not os.environ.get("P2PDL_BENCH_SKIP_PROBE"):
         env[_PROBE_OK_ENV] = "1"
         env["P2PDL_BENCH_SKIP_PROBE"] = "1"  # verdict decided; don't re-gate
         env.setdefault("P2PDL_BENCH_STAGES", "8,128")
+        # Ship the accelerator probe's forensics across the exec boundary:
+        # the fallback record must say what it fell back from.
+        env[_PROBE_DIAG_ENV] = json.dumps(_PROBE_DIAGNOSTICS)
         os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
     else:
         rec = _unreachable_record_for_mode(sys.argv)
@@ -643,6 +661,11 @@ def run_staged_headline() -> dict:
                 "metric": name,
                 "value": round(out[0], 3),
                 "unit": "rounds/sec",
+                # Stage rows are long-lived (no-clobber + last_good reads
+                # them across runs), so each one says which backend
+                # measured it — a CPU-fallback row must never pass as an
+                # accelerator capture in a later run's provenance.
+                "backend": jax.default_backend(),
                 "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                 **out[1],
             }
@@ -1393,6 +1416,12 @@ def main() -> None:
         rec["flight"] = flight_block()
     except Exception as e:  # noqa: BLE001 - headline must still print
         rec["flight"] = {"error": str(e)[:300]}
+    # Probe forensics ride the SUCCESS tail too (not just unreachable
+    # records): a CPU-fallback headline carries the accelerator attempts
+    # it fell back from (re-exec'd in via P2PDL_BENCH_PROBE_DIAGNOSTICS),
+    # a healthy run carries its clean "ok" row.
+    if _PROBE_DIAGNOSTICS:
+        rec["probe_diagnostics"] = list(_PROBE_DIAGNOSTICS)
     print(json.dumps(rec))
 
 

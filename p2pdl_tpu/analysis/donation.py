@@ -1,0 +1,67 @@
+"""Donation-discipline rule: dispatch-site ``jax.jit`` must donate its
+state carry.
+
+The round programs donate their ``PeerState`` argument
+(``donate_argnums=(0,)``) so XLA reuses the old state's buffers for the
+new state instead of holding both live across the dispatch. The depth-k
+pipelined loop raises the stakes: with ``pipeline_depth`` rounds in
+flight, an undonated state carry keeps k+1 copies of the working set live
+at once — at 1024 peers that is an OOM, not a slowdown.
+
+This rule flags every ``jax.jit`` call (or bare ``@jax.jit`` decorator,
+which cannot pass donation at all) in the dispatch-site module
+(``parallel/round.py``) that does not pass ``donate_argnums`` /
+``donate_argnames``. Sites that legitimately must NOT donate — the trust
+pipeline's ``train_fn`` (the state is re-consumed by ``agg_fn``), the
+digest pack (reads a delta the aggregate still needs), held-out eval (the
+state is read every round) — are sanctioned in the committed baseline with
+their reasons.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from p2pdl_tpu.analysis.engine import Finding, ModuleInfo, Rule, register
+
+_DONATE_KEYWORDS = {"donate_argnums", "donate_argnames"}
+
+_MSG = (
+    "`jax.jit` at a dispatch site without `donate_argnums`: an undonated "
+    "state carry keeps the previous buffers live across the dispatch "
+    "(k+1 working sets with depth-k pipelining in flight); donate the "
+    "state-carry args or sanction the site with a reason"
+)
+
+_DECORATOR_MSG = (
+    "bare `@jax.jit` decorator at a dispatch site cannot pass "
+    "`donate_argnums`; use `jax.jit(fn, donate_argnums=...)` or sanction "
+    "the site with a reason"
+)
+
+
+class DonationRule(Rule):
+    name = "donation-discipline"
+    description = "dispatch-site jax.jit must donate its state-carry args"
+    scope = ("parallel/round.py",)
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                if mod.dotted(node.func) != "jax.jit":
+                    continue
+                if any(kw.arg in _DONATE_KEYWORDS for kw in node.keywords):
+                    continue
+                yield mod.finding(self.name, node, _MSG)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    # A bare `@jax.jit` (no call parens) — a Call decorator
+                    # is already handled by the branch above.
+                    if not isinstance(dec, ast.Call) and (
+                        mod.dotted(dec) == "jax.jit"
+                    ):
+                        yield mod.finding(self.name, dec, _DECORATOR_MSG)
+
+
+register(DonationRule())

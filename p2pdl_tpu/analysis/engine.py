@@ -253,6 +253,7 @@ def all_rules() -> list[Rule]:
         from p2pdl_tpu.analysis import (  # noqa: F401
             cardinality,
             determinism,
+            donation,
             hostsync,
             locks,
             wire,

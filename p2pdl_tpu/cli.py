@@ -71,10 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="quantization levels for --compress qsgd (256 ~ 8-bit)",
     )
     p.add_argument(
-        "--selection", choices=("uniform", "power_of_choice"), default="uniform",
-        help="trainer sampler: uniform (reference semantics) or "
-        "power_of_choice (Cho et al. 2020 — poc-candidates uniform "
-        "candidates, keep the highest-loss trainers)",
+        "--selection", choices=("uniform", "random", "power_of_choice"),
+        default="uniform",
+        help="trainer sampler: uniform (reference semantics; 'random' is "
+        "an alias) or power_of_choice (Cho et al. 2020 — poc-candidates "
+        "uniform candidates, keep the highest-loss trainers)",
     )
     p.add_argument(
         "--poc-candidates", type=int, default=0,
@@ -413,8 +414,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-pipeline",
         action="store_true",
         help="disable the pipelined round loop (eval/loss readbacks fetched "
-        "one round late); the record stream is bit-identical either way "
-        "minus duration_s",
+        "up to --pipeline-depth rounds late); the record stream is "
+        "bit-identical either way minus duration_s",
+    )
+    p.add_argument(
+        "--pipeline-depth",
+        type=int,
+        default=2,
+        help="bounded in-flight round window for the pipelined loop "
+        "(default 2); readbacks resolve up to k rounds late, records stay "
+        "bit-identical at every depth — watch driver.overlap_efficiency "
+        "to see whether a deeper window still buys anything",
     )
     p.add_argument("--port", type=int, default=5000, help="HTTP port (serve mode)")
     p.add_argument("--n-devices", type=int, default=None, help="mesh size (default: all)")
@@ -555,6 +565,20 @@ _LOWER_BETTER = (
 # final path component).
 _DIFF_SKIP = ("count", "rounds", "expected", "monitored", "available", "n", "rc")
 
+# Built-in per-metric default thresholds (matched on the leaf path
+# component) for ratio metrics whose noise floor differs from the 5%
+# default: mfu divides throughput by a fixed chip peak, so it inherits
+# per_sec jitter but is reported to fewer digits; overlap efficiency is a
+# quotient of two wall-clock estimates (hidden / tail) and jitters hardest
+# of anything the gate sees. An explicit ``--threshold METRIC=FRAC``
+# override still wins; a bare ``--threshold FRAC`` only moves the generic
+# default.
+_LEAF_THRESHOLDS = {
+    "mfu": 0.10,
+    "efficiency": 0.15,
+    "overlap_efficiency": 0.15,
+}
+
 
 def metric_direction(name: str) -> str:
     """'up' (bigger is better), 'down' (smaller is better), or 'info'."""
@@ -629,9 +653,12 @@ def perf_diff(
 
     A metric regresses when it moves in its bad direction by more than its
     threshold, *relatively* (``|delta| / |old|``; an old value of exactly 0
-    compares absolutely so a 0 → 0.1s latency still trips). Metrics present
-    on only one side are reported but never fail the gate — perf planes
-    grow sections over time and the gate must not punish that.
+    compares absolutely so a 0 → 0.1s latency still trips). Threshold
+    resolution: exact-name ``per_metric`` override, else the built-in
+    ``_LEAF_THRESHOLDS`` default for noisy ratio leaves (mfu, overlap
+    efficiency), else ``default_threshold``. Metrics present on only one
+    side are reported but never fail the gate — perf planes grow sections
+    over time and the gate must not punish that.
     """
     per_metric = per_metric or {}
     rows = []
@@ -647,7 +674,10 @@ def perf_diff(
         direction = metric_direction(name)
         delta = n - o
         rel = abs(delta) / abs(o) if o != 0 else (0.0 if delta == 0 else abs(delta))
-        threshold = per_metric.get(name, default_threshold)
+        threshold = per_metric.get(
+            name,
+            _LEAF_THRESHOLDS.get(name.rsplit(".", 1)[-1], default_threshold),
+        )
         bad = (direction == "up" and delta < 0) or (direction == "down" and delta > 0)
         status = "ok"
         if direction == "info":
@@ -1258,8 +1288,11 @@ def main(argv: list[str] | None = None) -> int:
         from p2pdl_tpu.utils import flight
 
         flight.set_enabled(True)
-    if fault_plan is not None and args.fused_rounds > 0:
-        _warn("a fault plan requires per-round driving; ignoring --fused-rounds")
+    if args.fused_rounds > 0 and cfg.selection == "power_of_choice":
+        _warn(
+            "power_of_choice needs per-round loss feedback; "
+            "ignoring --fused-rounds"
+        )
         args.fused_rounds = 0
     exp = Experiment(
         cfg, attack=args.attack, byz_ids=byz_ids,
@@ -1267,8 +1300,22 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_dir=args.checkpoint_dir, checkpoint_every=args.checkpoint_every,
         profile_dir=args.profile_dir, failure_cooldown_rounds=args.failure_cooldown,
         fault_plan=fault_plan, pipeline=not args.no_pipeline,
+        pipeline_depth=args.pipeline_depth,
         perf=args.perf, audit=args.audit,
     )
+    # Omission-only plans (crashes/drops/partitions) now run fused via the
+    # precomputed schedule arrays; only content/ordering faults still need
+    # per-round driving (they act on in-flight control messages).
+    if (
+        args.fused_rounds > 0
+        and exp.faults is not None
+        and not exp.faults.plan.is_omission_only()
+    ):
+        _warn(
+            "content/ordering faults require per-round driving; "
+            "ignoring --fused-rounds"
+        )
+        args.fused_rounds = 0
     emit = lambda rec: print(json.dumps(rec.to_dict()), flush=True)  # noqa: E731
     with exp.profiler.trace():
         if args.fused_rounds > 0:

@@ -135,7 +135,9 @@ class Config:
     # algorithm's inherent cost, reference-less).
     scaffold: bool = False
     # Client selection (the host round driver's trainer sampler).
-    # "uniform" = the reference's random sample (main.py:52-54).
+    # "uniform" = the reference's random sample (main.py:52-54); "random"
+    # is an accepted alias for it (the reference's own name for the
+    # sampler) — identical draws, identical schedules.
     # "power_of_choice" = biased selection (Cho et al. 2020): draw
     # poc_candidates candidates uniformly, then pick the trainers_per_round
     # with the HIGHEST last-known local loss — faster early convergence on
@@ -742,10 +744,10 @@ class Config:
             # dense twin (tested per axis).
         if self.fedprox_mu < 0.0:
             raise ValueError(f"fedprox_mu must be >= 0 (0 = off), got {self.fedprox_mu}")
-        if self.selection not in ("uniform", "power_of_choice"):
+        if self.selection not in ("uniform", "random", "power_of_choice"):
             raise ValueError(
                 f"unknown selection {self.selection!r}; one of "
-                f"('uniform', 'power_of_choice')"
+                f"('uniform', 'random', 'power_of_choice')"
             )
         if self.poc_candidates < 0 or self.poc_candidates > self.num_peers:
             raise ValueError(

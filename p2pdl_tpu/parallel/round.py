@@ -790,7 +790,8 @@ def build_multi_round_fn(
     cfg: Config, mesh: Mesh, attack: str = "none", pair_seeds=None
 ) -> Callable:
     """Compile R rounds as ONE device program: ``(state, x, y, trainer_mat
-    [R, T], byz_gate, base_key) -> (state', {"train_loss": [R, P]})``.
+    [R, T], byz_gate [P] or [R, P], base_key) -> (state',
+    {"train_loss": [R, P]})``.
 
     A ``lax.scan`` over rounds inside the same ``shard_map`` — the
     round-loop boundary costs zero host round-trips, so configs whose
@@ -850,12 +851,12 @@ def build_multi_round_fn(
     ):
         def step(carry, inputs):
             params, opt_state, server_m, server_v, extras = carry
-            trainer_idx, r = inputs
+            trainer_idx, gate_row, r = inputs
             # Absolute round index — identical mask/attack keys to the
             # sequential driver's fold_in(base, round_idx).
             mask_key = jax.random.fold_in(base_key, round0 + r)
             outs = body(
-                params, opt_state, *extras, rng, x, y, trainer_idx, byz_gate, round0 + r, mask_key
+                params, opt_state, *extras, rng, x, y, trainer_idx, gate_row, round0 + r, mask_key
             )
             new_p, new_opt, losses = outs[:3]
             # SCAFFOLD: (c, ci); compression: (err,) — the bodies emit the
@@ -870,10 +871,14 @@ def build_multi_round_fn(
             return (new_p, new_opt, server_m, server_v, extras), losses
 
         rounds = trainer_mat.shape[0]
+        # The per-round host decisions ride the scan xs as schedule arrays:
+        # trainer rows [R, T] and byz-gate rows [R, P] — the device program
+        # consumes one row per round, so per-round gating composes with
+        # fusion with zero host round-trips.
         (params, opt_state, server_m, server_v, extras), losses = lax.scan(
             step,
             (params, opt_state, server_m, server_v, extras),
-            (trainer_mat, jnp.arange(rounds)),
+            (trainer_mat, byz_gate, jnp.arange(rounds)),
         )
         return params, opt_state, server_m, server_v, extras, losses  # losses: [R, L]
 
@@ -910,6 +915,13 @@ def build_multi_round_fn(
     )
 
     def multi_round_fn(state: PeerState, x, y, trainer_mat, byz_gate, base_key):
+        # Accept either a static [P] gate (broadcast to every round of the
+        # block) or a precomputed [R, P] per-round schedule; either way the
+        # scan consumes one gate row per round.
+        if byz_gate.ndim == 1:
+            byz_gate = jnp.broadcast_to(
+                byz_gate, (trainer_mat.shape[0],) + byz_gate.shape
+            )
         extras = tuple(getattr(state, f) for f, _ in extra_fields)
         new_params, new_opt, server_m, server_v, extras, losses = smapped(
             state.params,

@@ -147,6 +147,24 @@ class FaultPlan:
             else self.heartbeat_loss_rate
         )
 
+    def is_omission_only(self) -> bool:
+        """True when every configured fault is an *omission* — crashes,
+        message drops, partitions, heartbeat loss — and nothing mutates
+        content or ordering (corrupt/delay/duplicate/reorder all zero).
+
+        Omission-only plans have a key closure property: with no hub
+        installed, their entire effect on a run is the membership schedule
+        (``FaultInjector.begin_round`` + ``heartbeat_ok``), which is a
+        pure function of ``(plan, round)`` — precomputable for a whole
+        block of rounds without running any of them. ``run_fused`` leans
+        on exactly this to compose fused device blocks with chaos."""
+        return (
+            self.corrupt_rate == 0.0
+            and self.delay_rate == 0.0
+            and self.duplicate_rate == 0.0
+            and self.reorder_rate == 0.0
+        )
+
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
 

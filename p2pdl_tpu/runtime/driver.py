@@ -18,12 +18,14 @@ delivery failures are recorded rather than hanging the experiment.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import hashlib
 import json
 import os
 import threading
 import time
+from collections.abc import Mapping
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
@@ -135,6 +137,37 @@ def _latency_block(latencies: list[float]) -> dict[str, Any]:
         "p99": q(0.99),
         "max": lats[-1],
     }
+
+
+class _LazyDigests(Mapping):
+    """Deferred digest table backed by an in-flight async D2H copy.
+
+    The driver starts ``copy_to_host_async()`` on the packed digest buffer
+    at dispatch time and hands THIS mapping to the trust plane; the first
+    key access resolves the copy (by then the transfer has been riding
+    under the trust plane's quorum reconfigure / broadcast prep, so the
+    blocking ``device_get`` is mostly a completion check) and hashes every
+    row once. Resolution is idempotent and the driver force-resolves after
+    the round, so ``driver.d2h_transfers`` counts exactly one transfer per
+    round whether or not the trust plane touched a digest."""
+
+    def __init__(self, resolve) -> None:
+        self._resolve = resolve
+        self._digests: Optional[dict[int, bytes]] = None
+
+    def materialize(self) -> dict[int, bytes]:
+        if self._digests is None:
+            self._digests = self._resolve()
+        return self._digests
+
+    def __getitem__(self, key: int) -> bytes:
+        return self.materialize()[key]
+
+    def __iter__(self):
+        return iter(self.materialize())
+
+    def __len__(self) -> int:
+        return len(self.materialize())
 
 
 class _TrustPlane:
@@ -454,6 +487,7 @@ class Experiment:
         failure_cooldown_rounds: int = 0,
         fault_plan: Optional[Any] = None,
         pipeline: bool = True,
+        pipeline_depth: int = 2,
         perf: bool = False,
         audit: bool = False,
     ) -> None:
@@ -461,15 +495,25 @@ class Experiment:
         self.attack = attack
         self.byz_ids = tuple(byz_ids)
         # Pipelined round loop (run_rounds/run): eval dispatches async and
-        # its scalars — plus the per-peer loss readback — are fetched one
-        # round late, so round r+1's device work overlaps round r's host
-        # record-keeping. The deferred readbacks land BEFORE round r+1
-        # samples roles (power_of_choice sees exactly the losses the
-        # synchronous loop would), at checkpoint boundaries, and at exit,
-        # so the RoundRecord stream is bit-identical (minus duration_s)
-        # with pipelining on or off. run_round() stays fully synchronous.
+        # its scalars — plus the per-peer loss readback — are fetched up to
+        # ``pipeline_depth`` rounds late, so rounds r+1..r+k's device work
+        # overlaps round r's host tail. Each in-flight round parks its
+        # readbacks in its own slot of a bounded deque (per-slot buffers:
+        # the compiled programs donate the state carry, so k slots hold k
+        # rounds' loss/eval buffers, not k copies of the working set). The
+        # deferred readbacks land BEFORE a round that needs them samples
+        # roles (power_of_choice drains the window first and so degrades
+        # to depth 1 — it needs round r-1's losses), at checkpoint
+        # boundaries, and at exit, so the RoundRecord stream is
+        # bit-identical (minus duration_s) at every depth, pipelining on
+        # or off. run_round() stays fully synchronous.
         self.pipeline = bool(pipeline)
-        self._pending_round: Optional[dict] = None
+        if pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {pipeline_depth}"
+            )
+        self.pipeline_depth = int(pipeline_depth)
+        self._pending_rounds: collections.deque[dict] = collections.deque()
         # Single-transfer digesting state (lazy: built from the first
         # round's delta tree; row hashing runs on the shared module pool).
         self._digest_pack = None
@@ -707,9 +751,12 @@ class Experiment:
         trainer) — O(T * leaves) blocking round trips. Here a jitted pack
         step (``parallel.build_digest_pack_fn``) flattens every trainer's
         delta into one contiguous ``[T, total_bytes]`` device buffer, ONE
-        ``jax.device_get`` moves it, and the per-row SHA-256 (bit-identical
-        to ``crypto.digest_update``) runs on a small host thread pool —
-        sha256 releases the GIL on large buffers, so rows hash in parallel.
+        ``jax.device_get`` moves it — started asynchronously at dispatch
+        and resolved lazily through :class:`_LazyDigests` so the copy
+        overlaps the trust plane's quorum prep — and the per-row SHA-256
+        (bit-identical to ``crypto.digest_update``) runs on a small host
+        thread pool — sha256 releases the GIL on large buffers, so rows
+        hash in parallel.
 
         ``padded`` is the round's full trainer vector including -1 vacancy
         slots (the pack function needs a static shape; vacant rows are
@@ -731,21 +778,39 @@ class Experiment:
             self.cost_model.capture("digest_pack", pack_fn, (delta, padded_dev))
         with self.sentinel.guard("digest_pack", r):
             packed = pack_fn(delta, padded_dev)
-        # p2plint: disable=hostsync-transfer -- THE audited single device->host transfer per round (driver.d2h_transfers)
-        buf = np.asarray(jax.device_get(packed))  # the round's one D2H
-        telemetry.counter("driver.d2h_transfers").inc()
-        flight.record("d2h", round=r, nbytes=int(buf.nbytes))
-        pool = _digest_pool()
-        futures = {
-            int(t): pool.submit(hash_row, buf[i])
-            for i, t in enumerate(padded_host)
-            if t >= 0
-        }
-        digests = {t: f.result() for t, f in futures.items()}
+        # Async readback: kick the D2H copy off NOW and resolve it only
+        # when the trust plane first touches a digest (building the SEND
+        # payloads, after its live-set/quorum reconfigure prep), so the
+        # transfer rides under the committee work instead of stalling the
+        # round loop right here. copy_to_host_async is best-effort — on
+        # backends without it the lazy resolution simply blocks exactly
+        # where the synchronous path used to.
+        try:
+            packed.copy_to_host_async()
+        except AttributeError:
+            pass
+
+        def _resolve() -> dict[int, bytes]:
+            # p2plint: disable=hostsync-transfer -- THE audited single device->host transfer per round (driver.d2h_transfers); the copy was started async at dispatch
+            buf = np.asarray(jax.device_get(packed))  # the round's one D2H
+            telemetry.counter("driver.d2h_transfers").inc()
+            flight.record("d2h", round=r, nbytes=int(buf.nbytes))
+            pool = _digest_pool()
+            futures = {
+                int(t): pool.submit(hash_row, buf[i])
+                for i, t in enumerate(padded_host)
+                if t >= 0
+            }
+            return {t: f.result() for t, f in futures.items()}
+
+        digests = _LazyDigests(_resolve)
         m0, b0 = self.trust.hub.messages_sent, self.trust.hub.bytes_sent
         delivered, failed, verified = self.trust.run_round(
             r, live.tolist(), digests, dark=frozenset(self.detector.suspected)
         )
+        # The one-transfer-per-round accounting invariant holds even when
+        # no payload ever touched the table (an empty trainer round).
+        digests.materialize()
         excluded = sorted(set(live.tolist()) - set(verified))
         msgs = self.trust.hub.messages_sent - m0
         nbytes = self.trust.hub.bytes_sent - b0
@@ -848,15 +913,24 @@ class Experiment:
         self, trainers: Optional[np.ndarray] = None, defer: bool = False
     ) -> Optional[RoundRecord]:
         """Dispatch one round. With ``defer=True`` the host-blocking
-        readbacks (per-peer losses, eval scalars) are parked in
-        ``_pending_round`` and resolved by the NEXT call (or an explicit
-        flush) — by then the device has finished them, so the fetch is
-        free, and round r+1's device work overlaps round r's host tail.
+        readbacks (per-peer losses, eval scalars) are parked in a slot of
+        ``_pending_rounds`` and resolved once the in-flight window fills
+        past ``pipeline_depth`` (or at an explicit flush) — by then the
+        device has finished them, so the fetch is free, and rounds
+        r+1..r+k's device work overlaps round r's host tail.
         Returns the round's record, or None when deferred."""
-        # Resolve round r-1 BEFORE this round's chaos/sampling: the flush
-        # sets _peer_losses, so power_of_choice samples round r from exactly
-        # the losses the synchronous loop would have seen.
-        self._flush_pending_round()
+        # Bound the in-flight window BEFORE this round's chaos/sampling.
+        # Uniform/random selection only needs the window to stay <= depth
+        # (oldest rounds flush first, preserving record order); biased
+        # selection needs round r-1's losses to sample round r, so
+        # power_of_choice drains the whole window — the same reason it is
+        # split-path in run_fused — and the stream stays bit-identical to
+        # the synchronous loop at every configured depth.
+        if self.cfg.selection == "power_of_choice":
+            self._flush_all_pending()
+        else:
+            while len(self._pending_rounds) >= self.pipeline_depth:
+                self._flush_pending_round()
         r = self._round_cursor
         # Anomaly watermark: everything the flight recorder counts between
         # here and this round's pending-record build belongs to round r
@@ -1160,7 +1234,7 @@ class Experiment:
             }
         # duration_s is measured at the dispatch/defer point (and is the one
         # field excluded from the bit-identity contract, see RoundRecord).
-        self._pending_round = {
+        self._pending_rounds.append({
             "r": r,
             "live": live,
             "losses_dev": losses_dev,
@@ -1186,8 +1260,17 @@ class Experiment:
             ),
             "mask_recoveries": mask_recoveries,
             "health": protocol_health,
-        }
+        })
         self._round_cursor = r + 1
+        # Dispatch-time window gauges: pipeline_depth is the CONFIGURED
+        # bound (0 when the loop runs synchronously), inflight_rounds the
+        # actual occupancy right after this dispatch — at steady state it
+        # saturates at the depth; shallower readings mean something keeps
+        # draining the window (checkpoints, biased selection, sync calls).
+        telemetry.gauge("driver.pipeline_depth").set(
+            self.pipeline_depth if (defer and self.pipeline) else 0
+        )
+        telemetry.gauge("driver.inflight_rounds").set(len(self._pending_rounds))
         boundary = (
             self.checkpointer is not None and (r + 1) % self.checkpoint_every == 0
         )
@@ -1195,9 +1278,7 @@ class Experiment:
         if not defer or boundary:
             # Checkpoint boundaries flush first so the saved state never
             # runs ahead of the recorded stream (sync-mode ordering).
-            record = self._flush_pending_round()
-        else:
-            telemetry.gauge("driver.pipeline_depth").set(1)
+            record = self._flush_all_pending()
         if boundary:
             self.checkpointer.save(self.state, self.cfg, extra=self._ckpt_extra)
         return record
@@ -1222,13 +1303,21 @@ class Experiment:
             )
             telemetry.counter("audit.violations", invariant=v.invariant).inc()
 
+    def _flush_all_pending(self) -> Optional[RoundRecord]:
+        """Drain the whole in-flight window, oldest round first; returns
+        the LAST record materialized (None when nothing was pending)."""
+        record = None
+        while self._pending_rounds:
+            record = self._flush_pending_round()
+        return record
+
     def _flush_pending_round(self) -> Optional[RoundRecord]:
-        """Resolve the deferred readbacks of the previously dispatched
-        round into its RoundRecord; no-op (None) when nothing is pending."""
-        p, self._pending_round = self._pending_round, None
-        if p is None:
+        """Resolve the deferred readbacks of the OLDEST in-flight round
+        into its RoundRecord; no-op (None) when nothing is pending."""
+        if not self._pending_rounds:
             return None
-        telemetry.gauge("driver.pipeline_depth").set(0)
+        p = self._pending_rounds.popleft()
+        telemetry.gauge("driver.inflight_rounds").set(len(self._pending_rounds))
         flush_t0 = self.profiler.clock()
         with self.profiler.phase("round.device", round=p["r"]):
             # THE sanctioned device-completion site: the flush must consume
@@ -1321,6 +1410,63 @@ class Experiment:
         ):
             self.checkpointer.save(self.state, self.cfg, extra=self._ckpt_extra)
 
+    def _fused_block_schedule(self, r0: int, block: int) -> dict[str, list]:
+        """Precompute one fused block's per-round host decisions as
+        schedule rows: the trainer matrix plus the chaos bookkeeping that
+        the split-path loop interleaves with device work.
+
+        Omission-only fault plans make this legal: with no hub installed,
+        ``FaultInjector.begin_round`` + ``heartbeat_ok`` are pure functions
+        of ``(plan, round)`` (see ``FaultPlan.is_omission_only``), so the
+        crash/suspicion/membership sequence for rounds r0..r0+block can be
+        replayed on the host up front — same calls, same order, same PRF
+        draws as :meth:`_run_one_round` — and the resulting exclusions land
+        in ``sample_roles`` exactly as the sequential loop would see them.
+        The device then consumes the rows as ``lax.scan`` schedule arrays.
+        """
+        rows: list[np.ndarray] = []
+        fault_events: list[Optional[list]] = []
+        suspected: list[Optional[list]] = []
+        excluded: list[Optional[list]] = []
+        injected: list[Optional[dict]] = []
+        for i in range(block):
+            r = r0 + i
+            events = suspected_now = excluded_now = injected_now = None
+            if self.faults is not None:
+                events = self.faults.begin_round(r)
+                responded = {
+                    p
+                    for p in range(self.cfg.num_peers)
+                    if self.faults.heartbeat_ok(r, p)
+                }
+                newly, recovered = self.detector.observe(r, responded)
+                for p in newly:
+                    # p2plint: disable=telemetry-cardinality -- deliberate per-peer suspicion series, O(num_peers) and folded past the registry cap
+                    telemetry.counter("chaos.suspected", peer=p).inc()
+                    events.append({"event": "suspected", "peer": p})
+                for p in recovered:
+                    # p2plint: disable=telemetry-cardinality -- deliberate per-peer suspicion series, O(num_peers) and folded past the registry cap
+                    telemetry.counter("chaos.unsuspected", peer=p).inc()
+                    events.append({"event": "unsuspected", "peer": p})
+                suspected_now = sorted(self.detector.suspected)
+                excluded_now = sorted(
+                    set(self.detector.suspected)
+                    | {p for p, until in self._suspect_until.items() if until >= r}
+                )
+                injected_now = dict(self.faults.round_injected)
+            rows.append(self.sample_roles(r))
+            fault_events.append(events)
+            suspected.append(suspected_now)
+            excluded.append(excluded_now)
+            injected.append(injected_now)
+        return {
+            "trainer_mat": np.stack(rows),
+            "fault_events": fault_events,
+            "suspected": suspected,
+            "excluded": excluded,
+            "injected": injected,
+        }
+
     def run_fused(
         self,
         rounds_per_call: int = 8,
@@ -1336,14 +1482,27 @@ class Experiment:
         round, ``None`` -> JSON null on interior rounds — evaluating interior
         rounds would re-serialize the device loop this mode exists to
         remove). ``on_record`` is called with each RoundRecord as blocks
-        complete (per-block streaming for CLI/monitoring)."""
+        complete (per-block streaming for CLI/monitoring).
+
+        Schedule-driven composition: uniform/random selection and
+        OMISSION-ONLY fault plans (crashes, drops, partitions, heartbeat
+        loss) run fused — their per-round host decisions are precomputed
+        into schedule arrays by :meth:`_fused_block_schedule` and consumed
+        on device one row per scanned round, bit-identical to the split
+        path at the same seed. BRB (the trust plane must interpose between
+        phases) and power_of_choice (needs round r-1's losses before
+        sampling round r) remain legitimately split-path, as do plans with
+        content/ordering faults (they act on in-flight control messages,
+        which a fused block has none of)."""
         if self.trust is not None:
             raise ValueError("run_fused requires brb_enabled=False")
-        if self.faults is not None:
+        if self.faults is not None and not self.faults.plan.is_omission_only():
             raise ValueError(
-                "run_fused cannot host a fault plan: crash/partition state "
-                "and heartbeats advance per round on the host, which a "
-                "fused device block bypasses — use run()"
+                "run_fused can only host an omission-only fault plan "
+                "(crashes/drops/partitions/heartbeat loss): content and "
+                "ordering faults (corrupt/delay/duplicate/reorder) mutate "
+                "in-flight control messages, which a fused device block "
+                "has none of — use run()"
             )
         if self.cfg.selection == "power_of_choice":
             raise ValueError(
@@ -1375,12 +1534,13 @@ class Experiment:
                     ),
                 ),
             )
-        self._flush_pending_round()  # a prior pipelined loop may have a tail
+        self._flush_all_pending()  # a prior pipelined loop may have a tail
         base_key = jax.random.PRNGKey(self.cfg.seed)
         while int(self.state.round_idx) < self.cfg.rounds:
             r0 = int(self.state.round_idx)
             block = min(rounds_per_call, self.cfg.rounds - r0)
-            trainer_mat = np.stack([self.sample_roles(r0 + i) for i in range(block)])
+            sched = self._fused_block_schedule(r0, block)
+            trainer_mat = sched["trainer_mat"]
             trainer_dev = jnp.asarray(trainer_mat, jnp.int32)
             if self.cost_model is not None:
                 self.cost_model.capture(
@@ -1429,6 +1589,10 @@ class Experiment:
                     eval_acc=float(ev["eval_acc"]) if last else None,
                     duration_s=dt,
                     dp_epsilon=self._dp_epsilon(r0 + i + 1),
+                    fault_events=sched["fault_events"][i],
+                    suspected_peers=sched["suspected"][i],
+                    excluded_peers=sched["excluded"][i],
+                    faults_injected=sched["injected"][i],
                 )
                 self.records.append(record)
                 self.metrics.log(record.to_dict())
@@ -1493,12 +1657,13 @@ class Experiment:
         """The round loop alone (no profiler trace, no final checkpoint —
         callers that wrap their own trace context, like the CLI, use this).
 
-        With ``self.pipeline`` (the default) rounds are dispatched one
-        ahead: round r's loss/eval readbacks resolve while round r+1's
-        device work runs, and the tail round is flushed explicitly before
-        returning — the record stream is bit-identical (minus duration_s)
-        to the synchronous loop. ``on_record`` is called with each record
-        as it materializes (one round late under pipelining)."""
+        With ``self.pipeline`` (the default) rounds are dispatched up to
+        ``pipeline_depth`` ahead: round r's loss/eval readbacks resolve
+        while rounds r+1..r+k's device work runs, and the tail window is
+        flushed explicitly before returning — the record stream is
+        bit-identical (minus duration_s) to the synchronous loop at every
+        depth. ``on_record`` is called with each record as it materializes
+        (up to ``pipeline_depth`` rounds late under pipelining)."""
         emitted = len(self.records)
 
         def emit() -> int:
@@ -1512,7 +1677,7 @@ class Experiment:
         while self._round_cursor < self.cfg.rounds:
             self._run_one_round(defer=self.pipeline)
             emitted = emit()
-        self._flush_pending_round()
+        self._flush_all_pending()
         emit()
         return self.records
 

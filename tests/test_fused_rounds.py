@@ -151,3 +151,59 @@ def test_run_fused_rejects_trust_plane(mesh8):
     exp = Experiment(CFG.replace(brb_enabled=True, byzantine_f=2))
     with pytest.raises(ValueError, match="brb"):
         exp.run_fused()
+
+
+# ---------------------------------------------------------------------------
+# Schedule-driven composition: selection + omission chaos inside the scan
+# ---------------------------------------------------------------------------
+
+# local_epochs=1 keeps the split path on the same single-epoch body the
+# fused scan uses; selection="random" exercises the host sampler whose
+# per-round draws must be replayed block-ahead into the trainer matrix.
+CHAOS_CFG = CFG.replace(local_epochs=1, selection="random")
+
+
+def test_run_fused_matches_run_with_selection_and_omission_chaos(mesh8, tmp_path):
+    """The acceptance-scenario composition: random selection + the
+    crash_drop_partition plan (crash-stop peers, heartbeat loss, a healing
+    partition — omission-only) run fused. The block-ahead schedule replays
+    the split path's host bookkeeping in its exact order, so final params,
+    losses, trainer rows, and every chaos record field are BIT-identical
+    at the same seed."""
+    seq = Experiment(
+        CHAOS_CFG, pipeline=False, fault_plan="crash_drop_partition",
+        log_path=str(tmp_path / "seq.jsonl"),
+    )
+    seq_records = seq.run()
+    fused = Experiment(
+        CHAOS_CFG, fault_plan="crash_drop_partition",
+        log_path=str(tmp_path / "fused.jsonl"),
+    )
+    fused_records = fused.run_fused(rounds_per_call=4)
+
+    assert [r.round for r in fused_records] == [r.round for r in seq_records]
+    for a, b in zip(fused_records, seq_records):
+        assert a.trainers == b.trainers
+        assert a.train_loss == b.train_loss  # bit-identical, not allclose
+        assert a.fault_events == b.fault_events
+        assert a.suspected_peers == b.suspected_peers
+        assert a.excluded_peers == b.excluded_peers
+        assert a.faults_injected == b.faults_injected
+    assert any(r.fault_events for r in fused_records)  # the plan actually fired
+    for a, b in zip(
+        jax.tree.leaves(fused.state.params), jax.tree.leaves(seq.state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # The schedule arrays ride the scan as traced xs: per-round membership
+    # changes must not perturb the compiled block programs.
+    assert fused.sentinel.recompiles == 0
+
+
+def test_run_fused_rejects_content_fault_plan(mesh8):
+    """The lossy scenario corrupts in-flight messages (corrupt_rate > 0) —
+    a fused block has no in-flight messages to corrupt, so composing it
+    would silently drop the faults. Rejected loudly instead."""
+    exp = Experiment(CFG.replace(local_epochs=1), fault_plan="lossy")
+    assert not exp.faults.plan.is_omission_only()
+    with pytest.raises(ValueError, match="omission-only"):
+        exp.run_fused()

@@ -217,6 +217,78 @@ def test_hostsync_scoped_to_driver_and_round():
     assert rules_of(lint(src, "parallel/round.py")) == {"hostsync-transfer"}
 
 
+# ---- donation discipline ----------------------------------------------------
+
+DONATION_PATH = "parallel/round.py"
+
+
+def test_donation_missing_donate_argnums_flagged():
+    findings = lint(
+        """
+        import jax
+
+        def build(round_fn):
+            return jax.jit(round_fn)
+        """,
+        DONATION_PATH,
+    )
+    assert rules_of(findings) == {"donation-discipline"}
+
+
+def test_donation_argnums_and_argnames_clean():
+    findings = lint(
+        """
+        import jax
+
+        def build(round_fn, other_fn):
+            a = jax.jit(round_fn, donate_argnums=(0,))
+            b = jax.jit(other_fn, donate_argnames=("state",))
+            return a, b
+        """,
+        DONATION_PATH,
+    )
+    assert [f for f in findings if f.rule == "donation-discipline"] == []
+
+
+def test_donation_bare_decorator_flagged():
+    findings = lint(
+        """
+        import jax
+
+        @jax.jit
+        def eval_fn(state, x):
+            return state
+        """,
+        DONATION_PATH,
+    )
+    assert rules_of(findings) == {"donation-discipline"}
+    assert any("decorator" in f.message for f in findings)
+
+
+def test_donation_suppression_honored():
+    findings = lint(
+        """
+        import jax
+
+        def build(train_fn):
+            return jax.jit(train_fn)  # p2plint: disable=donation-discipline -- state re-consumed by agg_fn after the BRB verdict
+        """,
+        DONATION_PATH,
+    )
+    assert findings == []
+
+
+def test_donation_scoped_to_dispatch_module():
+    src = """
+        import jax
+
+        def build(fn):
+            return jax.jit(fn)
+        """
+    assert rules_of(lint(src, "runtime/driver.py")) == set()
+    assert rules_of(lint(src, DONATION_PATH)) == {"donation-discipline"}
+
+
 # ---- lock discipline --------------------------------------------------------
 
 

@@ -348,6 +348,56 @@ def test_cli_perf_diff_threshold_overrides(tmp_path):
     assert proc.returncode == 1, proc.stdout  # per-metric override wins
 
 
+def test_cli_perf_diff_leaf_thresholds_for_mfu_and_efficiency(tmp_path):
+    """mfu and overlap efficiency carry wider built-in thresholds (10% /
+    15%) than the 5% generic default: a 7% mfu dip and an 11% efficiency
+    dip are noise-floor moves, not regressions — but past their own
+    thresholds they still trip the gate."""
+    old, new = tmp_path / "old.json", tmp_path / "new.json"
+    old.write_text(json.dumps({
+        "bench": {"metric": "agg_rounds_per_sec_1024peers_mlp",
+                  "value": 2000.0, "mfu": 0.85},
+        "overlap": {"efficiency": 0.90},
+    }))
+    new.write_text(json.dumps({
+        "bench": {"metric": "agg_rounds_per_sec_1024peers_mlp",
+                  "value": 2000.0, "mfu": 0.79},  # -7%: > 5%, < mfu's 10%
+        "overlap": {"efficiency": 0.80},  # -11%: > 5%, < efficiency's 15%
+    }))
+    proc = _run(
+        [sys.executable, "-m", "p2pdl_tpu.cli", "perf-diff",
+         "--old", str(old), "--new", str(new)],
+        tmp_path,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
+    new.write_text(json.dumps({
+        "bench": {"metric": "agg_rounds_per_sec_1024peers_mlp",
+                  "value": 2000.0, "mfu": 0.70},  # -17.6%: past mfu's 10%
+        "overlap": {"efficiency": 0.60},  # -33%: past efficiency's 15%
+    }))
+    proc = _run(
+        [sys.executable, "-m", "p2pdl_tpu.cli", "perf-diff", "--json",
+         "--old", str(old), "--new", str(new)],
+        tmp_path,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr[-2000:]
+    doc = json.loads(proc.stdout)
+    bad = sorted(r["metric"] for r in doc["rows"] if r["status"] == "regression")
+    assert bad == [
+        "bench.agg_rounds_per_sec_1024peers_mlp.mfu",
+        "overlap.efficiency",
+    ]
+    # An explicit per-metric override still beats the built-in leaf default.
+    proc = _run(
+        [sys.executable, "-m", "p2pdl_tpu.cli", "perf-diff",
+         "--old", str(old), "--new", str(new),
+         "--threshold", "bench.agg_rounds_per_sec_1024peers_mlp.mfu=0.2",
+         "--threshold", "overlap.efficiency=0.5"],
+        tmp_path,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
+
+
 def test_cli_perf_diff_usage_errors(tmp_path):
     proc = _run([sys.executable, "-m", "p2pdl_tpu.cli", "perf-diff"], tmp_path)
     assert proc.returncode == 2  # no inputs, no BENCH_r*.json in cwd
