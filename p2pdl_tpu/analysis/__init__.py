@@ -1,9 +1,11 @@
 """p2plint: project-native static invariant checks (pure stdlib).
 
 Public surface: the engine (:func:`run_lint`, :func:`lint_source`,
-:func:`cli_lint`) plus the four rule families registered on import —
-determinism, host-sync, lock discipline, and wire conformance. See
-``engine.py`` for the suppression and baseline model.
+:func:`cli_lint`) plus the rule families registered on import —
+determinism, host-sync, lock discipline, wire conformance, and the
+interprocedural families (wire-taint, lock-membership, lock-order) built
+on the call-graph/dataflow layer (``callgraph.py`` / ``dataflow.py``).
+See ``engine.py`` for the suppression and baseline model.
 """
 
 from p2pdl_tpu.analysis.engine import (  # noqa: F401
@@ -11,14 +13,19 @@ from p2pdl_tpu.analysis.engine import (  # noqa: F401
     Finding,
     LintResult,
     ModuleInfo,
+    Program,
+    ProgramRule,
     Rule,
     all_rules,
+    changed_files,
     cli_lint,
     lint_source,
     lint_tree,
     load_baseline,
     render_json,
+    render_sarif,
     render_text,
+    resolve_rules,
     run_lint,
     write_baseline_file,
 )

@@ -1,0 +1,264 @@
+"""Conservative intra-package call graph for interprocedural rules.
+
+The graph resolves only what a lexical reading of the tree can prove:
+
+- bare calls to same-module functions (``helper(x)``),
+- ``self.method()`` calls within the defining class,
+- class-qualified calls (``Broadcaster.handle(b, m)``, ``Cls()`` to
+  ``Cls.__init__``),
+- module-qualified and ``from``-imported calls through the engine's
+  import-alias map (``transport.recv_frame`` / ``recv_frame`` after
+  ``from ..protocol.transport import recv_frame``).
+
+Anything dynamic — callables stored in attributes or registries
+(``self.handler(...)``), duck-typed method calls on arbitrary objects,
+inheritance dispatch — produces *no* edge. Rules built on top must treat
+an unresolved call conservatively (taint flows through its return;
+lock-held attribution only trusts resolved paths), and the README
+documents the soundness limit.
+
+Function keys are ``"<relpath>::<qualname>"`` so the graph spans modules
+without name collisions.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional, Union
+
+from p2pdl_tpu.analysis.engine import ModuleInfo
+
+FunctionDefT = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+#: Dotted-module prefix fixture trees lack but in-repo imports carry.
+_PACKAGE = "p2pdl_tpu"
+
+
+@dataclasses.dataclass
+class FunctionNode:
+    """One function or method definition."""
+
+    key: str
+    relpath: str  # ModuleInfo.relpath of the defining module
+    qualname: str  # "Cls.method", "func", or "outer.inner"
+    cls: Optional[str]  # enclosing class qualname for methods, else None
+    node: FunctionDefT
+    mod: ModuleInfo
+
+    @property
+    def short_name(self) -> str:
+        return self.node.name
+
+    def param_names(self, skip_self: bool = True) -> list[str]:
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args]
+        if skip_self and self.cls is not None and names and names[0] in ("self", "cls"):
+            names = names[1:]
+        return names
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One resolved call edge: ``caller`` invokes ``callee`` at ``call``."""
+
+    caller: str  # FunctionNode key
+    callee: str  # FunctionNode key
+    call: ast.Call
+    relpath: str  # module containing the call site
+
+
+class CallGraph:
+    def __init__(self) -> None:
+        self.functions: dict[str, FunctionNode] = {}
+        self._by_caller: dict[str, list[CallSite]] = {}
+        self._by_callee: dict[str, list[CallSite]] = {}
+        #: id(ast.Call) -> callee key, for rules walking function bodies.
+        self.resolved_calls: dict[int, str] = {}
+
+    def add_edge(self, site: CallSite) -> None:
+        self._by_caller.setdefault(site.caller, []).append(site)
+        self._by_callee.setdefault(site.callee, []).append(site)
+        self.resolved_calls[id(site.call)] = site.callee
+
+    def callees_of(self, key: str) -> list[CallSite]:
+        return self._by_caller.get(key, [])
+
+    def callers_of(self, key: str) -> list[CallSite]:
+        return self._by_callee.get(key, [])
+
+    def methods_of(self, relpath: str, cls_qual: str) -> list[FunctionNode]:
+        return [
+            fn
+            for fn in self.functions.values()
+            if fn.relpath == relpath and fn.cls == cls_qual
+        ]
+
+
+def _module_dotted(mod: ModuleInfo) -> str:
+    """``protocol/transport.py`` -> ``p2pdl_tpu.protocol.transport``."""
+    p = mod.norm_relpath
+    if p.endswith(".py"):
+        p = p[: -len(".py")]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    dotted = p.replace("/", ".")
+    return f"{_PACKAGE}.{dotted}" if dotted else _PACKAGE
+
+
+class _ModuleIndex:
+    """Per-module definition tables used during resolution."""
+
+    def __init__(self, mod: ModuleInfo) -> None:
+        self.mod = mod
+        self.functions: dict[str, FunctionNode] = {}  # top-level name -> node
+        self.methods: dict[tuple[str, str], FunctionNode] = {}  # (cls, name)
+        self.classes: set[str] = set()
+
+
+def _collect_definitions(
+    mods: list[ModuleInfo], graph: CallGraph
+) -> dict[str, _ModuleIndex]:
+    indexes: dict[str, _ModuleIndex] = {}
+    for mod in mods:
+        idx = _ModuleIndex(mod)
+        indexes[mod.relpath] = idx
+        # Class methods: functions whose *direct* parent is a ClassDef.
+        # NB: ``context_of`` on a def/class node is its *own* qualname.
+        method_nodes: set[int] = set()
+        for node in mod.walk():
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls_qual = mod.context_of(node)
+            idx.classes.add(cls_qual)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = mod.context_of(item)
+                    fn = FunctionNode(
+                        key=f"{mod.relpath}::{qual}",
+                        relpath=mod.relpath,
+                        qualname=qual,
+                        cls=cls_qual,
+                        node=item,
+                        mod=mod,
+                    )
+                    graph.functions[fn.key] = fn
+                    idx.methods[(cls_qual, item.name)] = fn
+                    method_nodes.add(id(item))
+        # Plain functions (top-level and nested, but not methods).
+        for node in mod.walk():
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if id(node) in method_nodes:
+                continue
+            qual = mod.context_of(node)
+            fn = FunctionNode(
+                key=f"{mod.relpath}::{qual}",
+                relpath=mod.relpath,
+                qualname=qual,
+                cls=None,
+                node=node,
+                mod=mod,
+            )
+            graph.functions[fn.key] = fn
+            if qual == node.name:  # top-level function
+                idx.functions[node.name] = fn
+    return indexes
+
+
+def _resolve_dotted(
+    dotted: str,
+    idx: _ModuleIndex,
+    by_module: dict[str, _ModuleIndex],
+) -> Optional[FunctionNode]:
+    """Resolve a canonical dotted chain to a definition.
+
+    Tries, in order: same-module function, same-module ``Cls.method``,
+    then the longest dotted-module prefix registered in ``by_module``
+    with the remainder as ``func`` or ``Cls.method``.
+    """
+    parts = dotted.split(".")
+    if len(parts) == 1:
+        fn = idx.functions.get(parts[0])
+        if fn is not None:
+            return fn
+        # Bare class name: constructor edge to Cls.__init__.
+        if parts[0] in idx.classes:
+            return idx.methods.get((parts[0], "__init__"))
+        return None
+    if len(parts) == 2 and parts[0] in idx.classes:
+        return idx.methods.get((parts[0], parts[1]))
+    for cut in range(len(parts) - 1, 0, -1):
+        target = by_module.get(".".join(parts[:cut]))
+        if target is None:
+            continue
+        rest = parts[cut:]
+        if len(rest) == 1:
+            fn = target.functions.get(rest[0])
+            if fn is not None:
+                return fn
+            if rest[0] in target.classes:
+                return target.methods.get((rest[0], "__init__"))
+        elif len(rest) == 2 and rest[0] in target.classes:
+            return target.methods.get((rest[0], rest[1]))
+        return None
+    return None
+
+
+def build_callgraph(mods: list[ModuleInfo]) -> CallGraph:
+    graph = CallGraph()
+    indexes = _collect_definitions(mods, graph)
+    by_module: dict[str, _ModuleIndex] = {}
+    for idx in indexes.values():
+        dotted = _module_dotted(idx.mod)
+        by_module[dotted] = idx
+        # Fixture trees import without the package prefix; register both.
+        if dotted.startswith(_PACKAGE + "."):
+            by_module.setdefault(dotted[len(_PACKAGE) + 1 :], idx)
+
+    # Caller attribution: enclosing-context qualname -> FunctionNode.
+    for mod in mods:
+        idx = indexes[mod.relpath]
+        quals = {
+            fn.qualname: fn
+            for fn in graph.functions.values()
+            if fn.relpath == mod.relpath
+        }
+        for node in mod.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            caller = quals.get(mod.context_of(node))
+            if caller is None:
+                continue  # module-level call (import time): not tracked
+            callee = _resolve_call(node, caller, idx, by_module)
+            if callee is not None:
+                graph.add_edge(
+                    CallSite(
+                        caller=caller.key,
+                        callee=callee.key,
+                        call=node,
+                        relpath=mod.relpath,
+                    )
+                )
+    return graph
+
+
+def _resolve_call(
+    call: ast.Call,
+    caller: FunctionNode,
+    idx: _ModuleIndex,
+    by_module: dict[str, _ModuleIndex],
+) -> Optional[FunctionNode]:
+    func = call.func
+    # self.method() within the defining class (single-class, no MRO walk).
+    if (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "self"
+        and caller.cls is not None
+    ):
+        return idx.methods.get((caller.cls, func.attr))
+    dotted = idx.mod.dotted(func)
+    if dotted is None:
+        return None
+    return _resolve_dotted(dotted, idx, by_module)

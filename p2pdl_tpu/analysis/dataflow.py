@@ -1,0 +1,447 @@
+"""Worklist dataflow over the call graph: taint, lock-held, lock-set facts.
+
+Three analyses share this module, all deliberately cheap and conservative:
+
+- :class:`TaintEngine` — interprocedural taint. Each function gets a
+  summary (which parameters are tainted, whether its return is tainted);
+  a worklist re-analyzes a function when a caller feeds taint into a new
+  parameter and re-analyzes callers when a callee's return flips tainted.
+  Facts are monotone (taint only spreads) so the fixpoint terminates.
+  What counts as a source / sanitizer / sink is a :class:`TaintPolicy`
+  supplied by the rule (``wiretaint``) — the engine only moves facts.
+- :func:`iter_lock_states` — a lexical scan yielding every expression
+  node with the set of locks held around it, plus each acquisition event.
+  Closures nested in a locked region are scanned as *unlocked* (they may
+  run later on any thread), matching the per-file lock rule.
+- :func:`always_locked` — greatest-fixpoint attribution: a function runs
+  lock-held on every path iff it has at least one in-graph caller and
+  every call site is either lexically under the lock or inside a function
+  that itself always runs lock-held. Entry points (no in-graph callers)
+  are never attributed — dynamic dispatch cannot smuggle in a lock.
+- :func:`transitive_acquires` — which locks a call may take, directly or
+  through callees (the lock-order cycle detector's edge source).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from collections import deque
+from typing import Callable, Hashable, Iterable, Iterator, Optional
+
+from p2pdl_tpu.analysis.callgraph import CallGraph, CallSite, FunctionNode
+from p2pdl_tpu.analysis.engine import Finding, ModuleInfo
+
+# ---- lexical lock states ----------------------------------------------------
+
+LockId = Hashable
+#: ("node", ast_node, held_lock_ids) or ("acquire", lock_id, with_node, held_before)
+LockEvent = tuple
+
+
+def iter_lock_states(
+    stmts: list[ast.stmt],
+    lock_id: Callable[[ast.AST], Optional[LockId]],
+    held: frozenset = frozenset(),
+    descend_closures: bool = True,
+) -> Iterator[LockEvent]:
+    """Walk statements in order, tracking the set of held lock identities.
+
+    Yields ``("node", node, held)`` for every AST node of every simple
+    statement (and compound-statement header expression), and
+    ``("acquire", lock, with_item_expr, held_before)`` at each ``with``
+    that takes a recognized lock. Closures are scanned with an empty held
+    set (they may run later on any thread) — or skipped entirely with
+    ``descend_closures=False`` when the caller analyzes nested functions
+    as call-graph nodes of their own.
+    """
+
+    def rec(body: list[ast.stmt], inner: frozenset) -> Iterator[LockEvent]:
+        return iter_lock_states(body, lock_id, inner, descend_closures)
+
+    for st in stmts:
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in st.items:
+                for n in ast.walk(item.context_expr):
+                    yield ("node", n, held)
+                lid = lock_id(item.context_expr)
+                if lid is not None:
+                    yield ("acquire", lid, item.context_expr, inner)
+                    inner = inner | {lid}
+            yield from rec(st.body, inner)
+        elif isinstance(st, (ast.If, ast.While)):
+            for n in ast.walk(st.test):
+                yield ("node", n, held)
+            yield from rec(st.body, held)
+            yield from rec(st.orelse, held)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            for n in ast.walk(st.iter):
+                yield ("node", n, held)
+            yield from rec(st.body, held)
+            yield from rec(st.orelse, held)
+        elif isinstance(st, ast.Try):
+            yield from rec(st.body, held)
+            for h in st.handlers:
+                yield from rec(h.body, held)
+            yield from rec(st.orelse, held)
+            yield from rec(st.finalbody, held)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # A closure defined here may run later on any thread.
+            if descend_closures:
+                yield from rec(st.body, frozenset())
+        elif isinstance(st, ast.ClassDef):
+            yield from rec(st.body, held)
+        else:
+            for n in ast.walk(st):
+                yield ("node", n, held)
+
+
+# ---- interprocedural lock attribution ---------------------------------------
+
+
+def always_locked(
+    graph: CallGraph, site_locked: Callable[[CallSite], bool]
+) -> set[str]:
+    """Function keys provably entered with the lock held on *every* path."""
+    safe = {k for k in graph.functions if graph.callers_of(k)}
+    changed = True
+    while changed:
+        changed = False
+        for k in list(safe):
+            ok = all(
+                site_locked(s) or s.caller in safe for s in graph.callers_of(k)
+            )
+            if not ok:
+                safe.discard(k)
+                changed = True
+    return safe
+
+
+def transitive_acquires(
+    graph: CallGraph,
+    direct: dict[str, frozenset],
+) -> dict[str, frozenset]:
+    """Close ``direct`` (locks each function acquires in its own body)
+    over call edges: what a call to each function may end up holding."""
+    acq = {k: direct.get(k, frozenset()) for k in graph.functions}
+    work = deque(graph.functions)
+    while work:
+        k = work.popleft()
+        total = acq[k]
+        for site in graph.callees_of(k):
+            total = total | acq.get(site.callee, frozenset())
+        if total != acq[k]:
+            acq[k] = total
+            for site in graph.callers_of(k):
+                work.append(site.caller)
+    return acq
+
+
+# ---- interprocedural taint --------------------------------------------------
+
+
+class TaintPolicy:
+    """What taints, what cleans, and what must never receive taint.
+
+    Subclasses (the rules) override the hooks; the engine stays generic.
+    """
+
+    #: Callee short names that do not receive caller taint: sanctioned
+    #: trust boundaries (parsers whose *output* re-enters as fresh taint,
+    #: and pre-verified handlers whose callers were already audited).
+    boundaries: frozenset = frozenset()
+
+    def in_scope(self, mod: ModuleInfo) -> bool:
+        return True
+
+    def is_source(self, mod: ModuleInfo, call: ast.Call) -> bool:
+        return False
+
+    def is_sanitizer(self, mod: ModuleInfo, call: ast.Call) -> bool:
+        return False
+
+    def check_call(
+        self, mod: ModuleInfo, call: ast.Call, tainted: Callable[[ast.AST], bool]
+    ) -> Iterable[Finding]:
+        """Call-shaped sinks (reads, allocations, parses, mutator writes)."""
+        return ()
+
+    def check_write(
+        self,
+        mod: ModuleInfo,
+        node: ast.AST,
+        target: ast.AST,
+        value_tainted: bool,
+        tainted: Callable[[ast.AST], bool],
+    ) -> Iterable[Finding]:
+        """Assignment-shaped sinks (``self.state[...] = tainted``)."""
+        return ()
+
+
+@dataclasses.dataclass
+class _Summary:
+    tainted_params: set = dataclasses.field(default_factory=set)
+    returns_tainted: bool = False
+    findings: list = dataclasses.field(default_factory=list)
+
+
+def _is_upper_const(e: ast.AST) -> bool:
+    if isinstance(e, ast.Constant):
+        return True
+    if isinstance(e, ast.Name) and e.id.isupper():
+        return True
+    if isinstance(e, ast.Attribute) and e.attr.isupper():
+        return True
+    return False
+
+
+class TaintEngine:
+    """Fixpoint driver + per-function abstract interpreter."""
+
+    _MAX_POPS = 20000  # termination backstop; never reached in practice
+
+    def __init__(
+        self, mods: list[ModuleInfo], graph: CallGraph, policy: TaintPolicy
+    ) -> None:
+        self.graph = graph
+        self.policy = policy
+        self.scope_keys = [
+            fn.key
+            for fn in graph.functions.values()
+            if policy.in_scope(fn.mod)
+        ]
+        self.summaries: dict[str, _Summary] = {
+            k: _Summary() for k in graph.functions
+        }
+        self._work: deque[str] = deque()
+        self._queued: set[str] = set()
+
+    def run(self) -> list[Finding]:
+        for k in self.scope_keys:
+            self._enqueue(k)
+        pops = 0
+        while self._work and pops < self._MAX_POPS:
+            key = self._work.popleft()
+            self._queued.discard(key)
+            pops += 1
+            self._analyze(key)
+        findings: list[Finding] = []
+        for k in self.scope_keys:
+            findings.extend(self.summaries[k].findings)
+        return findings
+
+    # -- worklist plumbing -------------------------------------------------
+
+    def _enqueue(self, key: str) -> None:
+        if key not in self._queued and key in self.summaries:
+            self._queued.add(key)
+            self._work.append(key)
+
+    def add_param_taint(self, callee_key: str, params: set) -> None:
+        summ = self.summaries.get(callee_key)
+        if summ is None or params <= summ.tainted_params:
+            return
+        summ.tainted_params |= params
+        fn = self.graph.functions.get(callee_key)
+        if fn is not None and self.policy.in_scope(fn.mod):
+            self._enqueue(callee_key)
+
+    def returns_tainted(self, callee_key: str) -> bool:
+        summ = self.summaries.get(callee_key)
+        return bool(summ and summ.returns_tainted)
+
+    def _analyze(self, key: str) -> None:
+        fn = self.graph.functions[key]
+        summ = self.summaries[key]
+        scan = _FunctionScan(self, fn)
+        scan.run()
+        summ.findings = scan.findings
+        if scan.returns_tainted and not summ.returns_tainted:
+            summ.returns_tainted = True  # monotone: never un-taints
+            for site in self.graph.callers_of(key):
+                self._enqueue(site.caller)
+
+
+class _FunctionScan:
+    """One in-order abstract pass over a function body.
+
+    Variable-level taint only (object attributes are not tracked as
+    separate cells — a tainted object taints every expression built from
+    it). Branches are not joined: taint accumulates, and only assignment
+    of a clean value or a sanitizer call removes it. Both choices bias
+    toward flagging, then sanitizers pull the false-positive rate down.
+    """
+
+    def __init__(self, engine: TaintEngine, fn: FunctionNode) -> None:
+        self.engine = engine
+        self.policy = engine.policy
+        self.fn = fn
+        self.mod = fn.mod
+        self.tainted: set[str] = set(
+            engine.summaries[fn.key].tainted_params
+        )
+        self.returns_tainted = False
+        self.findings: list[Finding] = []
+        self._checked_calls: set[int] = set()
+
+    def run(self) -> None:
+        self._visit_stmts(self.fn.node.body)
+
+    # -- expressions -------------------------------------------------------
+
+    def _tainted(self, e: Optional[ast.AST]) -> bool:
+        if e is None:
+            return False
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, ast.Call):
+            return self._call(e)
+        if isinstance(e, ast.Lambda):
+            return False
+        return any(self._tainted(c) for c in ast.iter_child_nodes(e))
+
+    def _call(self, call: ast.Call) -> bool:
+        mod = self.mod
+        if self.policy.is_sanitizer(mod, call):
+            self._sanitize_names(call)
+            return False
+        arg_tainted = [self._tainted(a) for a in call.args]
+        kw_tainted = {
+            kw.arg: self._tainted(kw.value)
+            for kw in call.keywords
+            if kw.arg is not None
+        }
+        recv_tainted = (
+            self._tainted(call.func.value)
+            if isinstance(call.func, ast.Attribute)
+            else False
+        )
+        if id(call) not in self._checked_calls:
+            self._checked_calls.add(id(call))
+            self.findings.extend(
+                self.policy.check_call(mod, call, self._tainted)
+            )
+        if self.policy.is_source(mod, call):
+            return True
+        callee_key = self.engine.graph.resolved_calls.get(id(call))
+        if callee_key is not None:
+            callee = self.engine.graph.functions[callee_key]
+            if callee.short_name not in self.policy.boundaries:
+                params = callee.param_names()
+                flow = {
+                    params[i]
+                    for i, t in enumerate(arg_tainted)
+                    if t and i < len(params)
+                }
+                flow |= {k for k, t in kw_tainted.items() if t and k in params}
+                if flow:
+                    self.engine.add_param_taint(callee_key, flow)
+            return self.engine.returns_tainted(callee_key)
+        # Unresolved call: taint flows through (bytes(x), x.decode(), ...).
+        return any(arg_tainted) or any(kw_tainted.values()) or recv_tainted
+
+    def _sanitize_names(self, call: ast.Call) -> None:
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for n in ast.walk(arg):
+                if isinstance(n, ast.Name):
+                    self.tainted.discard(n.id)
+
+    def _apply_bound_checks(self, test: ast.AST) -> None:
+        """Explicit shape validation sanitizes: comparing a tainted value
+        (or its ``len()``) against a constant / ALL-CAPS bound means the
+        code inspected the attacker-controlled quantity."""
+        for cmp_node in ast.walk(test):
+            if not isinstance(cmp_node, ast.Compare):
+                continue
+            sides = [cmp_node.left] + list(cmp_node.comparators)
+            if not any(_is_upper_const(s) for s in sides):
+                continue
+            for side in sides:
+                if isinstance(side, ast.Call) and isinstance(
+                    side.func, ast.Name
+                ) and side.func.id == "len":
+                    for a in side.args:
+                        for n in ast.walk(a):
+                            if isinstance(n, ast.Name):
+                                self.tainted.discard(n.id)
+                elif isinstance(side, ast.Name):
+                    self.tainted.discard(side.id)
+
+    # -- statements --------------------------------------------------------
+
+    def _assign_target(self, t: ast.AST, value_tainted: bool, node: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            if value_tainted:
+                self.tainted.add(t.id)
+            else:
+                self.tainted.discard(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for elt in t.elts:
+                inner = elt.value if isinstance(elt, ast.Starred) else elt
+                self._assign_target(inner, value_tainted, node)
+        elif isinstance(t, (ast.Attribute, ast.Subscript)):
+            if isinstance(t, ast.Subscript):
+                self._tainted(t.slice)
+            self.findings.extend(
+                self.policy.check_write(
+                    self.mod, node, t, value_tainted, self._tainted
+                )
+            )
+
+    def _visit_stmts(self, stmts: list[ast.stmt]) -> None:
+        for st in stmts:
+            if isinstance(st, ast.Assign):
+                vt = self._tainted(st.value)
+                for t in st.targets:
+                    self._assign_target(t, vt, st)
+            elif isinstance(st, ast.AnnAssign):
+                vt = self._tainted(st.value) if st.value is not None else False
+                self._assign_target(st.target, vt, st)
+            elif isinstance(st, ast.AugAssign):
+                vt = self._tainted(st.value)
+                if isinstance(st.target, ast.Name):
+                    if vt:
+                        self.tainted.add(st.target.id)
+                else:
+                    self._assign_target(
+                        st.target,
+                        vt or self._tainted(st.target),
+                        st,
+                    )
+            elif isinstance(st, ast.Expr):
+                self._tainted(st.value)
+            elif isinstance(st, ast.Return):
+                if self._tainted(st.value):
+                    self.returns_tainted = True
+            elif isinstance(st, (ast.If, ast.While)):
+                self._tainted(st.test)
+                self._apply_bound_checks(st.test)
+                self._visit_stmts(st.body)
+                self._visit_stmts(st.orelse)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                it = self._tainted(st.iter)
+                self._assign_target(st.target, it, st)
+                self._visit_stmts(st.body)
+                self._visit_stmts(st.orelse)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    ct = self._tainted(item.context_expr)
+                    if item.optional_vars is not None:
+                        self._assign_target(item.optional_vars, ct, st)
+                self._visit_stmts(st.body)
+            elif isinstance(st, ast.Try):
+                self._visit_stmts(st.body)
+                for h in st.handlers:
+                    self._visit_stmts(h.body)
+                self._visit_stmts(st.orelse)
+                self._visit_stmts(st.finalbody)
+            elif isinstance(st, (ast.Raise, ast.Assert)):
+                for child in ast.iter_child_nodes(st):
+                    self._tainted(child)
+            elif isinstance(st, ast.Delete):
+                for t in st.targets:
+                    if isinstance(t, ast.Name):
+                        self.tainted.discard(t.id)
+            # Nested defs are separate call-graph nodes; class bodies,
+            # imports, and control keywords carry no taint.

@@ -41,6 +41,8 @@ import ast
 import dataclasses
 import json
 import os
+import subprocess
+import time
 from typing import Any, Iterable, Optional
 
 DIRECTIVE = "p2plint:"
@@ -174,6 +176,14 @@ class ModuleInfo:
         self.contexts = _build_contexts(self.tree)
         self.aliases = _build_aliases(self.tree)
         self.suppressions = Suppressions(self.lines)
+        self._walk_cache: Optional[list[ast.AST]] = None
+
+    def walk(self) -> list[ast.AST]:
+        """Every AST node, computed once and shared by all rules (each rule
+        used to re-run ``ast.walk`` over the same tree)."""
+        if self._walk_cache is None:
+            self._walk_cache = list(ast.walk(self.tree))
+        return self._walk_cache
 
     @property
     def norm_relpath(self) -> str:
@@ -235,6 +245,41 @@ class Rule:
         raise NotImplementedError
 
 
+class Program:
+    """The whole-tree view program rules analyze: every parsed module plus
+    a lazily-built conservative call graph shared across rules."""
+
+    def __init__(self, mods: list[ModuleInfo]) -> None:
+        self.mods = mods
+        self._by_relpath = {m.relpath: m for m in mods}
+        self._callgraph: Any = None
+
+    def module(self, relpath: str) -> Optional[ModuleInfo]:
+        return self._by_relpath.get(relpath)
+
+    @property
+    def callgraph(self):
+        if self._callgraph is None:
+            from p2pdl_tpu.analysis.callgraph import build_callgraph
+
+            self._callgraph = build_callgraph(self.mods)
+        return self._callgraph
+
+
+class ProgramRule(Rule):
+    """A whole-program checker: sees every module at once (plus the shared
+    call graph) instead of one file at a time. ``scope`` still applies —
+    use :meth:`applies` inside ``check_program`` to filter modules."""
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError("program rules implement check_program")
+
+    def check_program(
+        self, program: Program
+    ) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
 _RULES: dict[str, Rule] = {}
 
 
@@ -255,23 +300,71 @@ def all_rules() -> list[Rule]:
             determinism,
             donation,
             hostsync,
+            lockflow,
             locks,
             wire,
+            wiretaint,
         )
 
     return list(_RULES.values())
 
 
-def lint_module(mod: ModuleInfo, rules: Optional[list[Rule]] = None) -> list[Finding]:
+def _parse_error_finding(relpath: str, e: SyntaxError) -> Finding:
+    return Finding(
+        rule="parse-error",
+        path=relpath.replace(os.sep, "/"),
+        line=e.lineno or 0,
+        col=e.offset or 0,
+        message=f"file does not parse: {e.msg}",
+        context="<module>",
+    )
+
+
+def lint_program(
+    mods: list[ModuleInfo],
+    rules: Optional[list[Rule]] = None,
+    timings: Optional[dict[str, float]] = None,
+) -> list[Finding]:
+    """Run per-module rules over each module and program rules once over
+    the whole module set; suppressions apply uniformly. ``timings``, if
+    given, accumulates per-rule wall seconds."""
+    rules = rules if rules is not None else all_rules()
+    per_module = [r for r in rules if not isinstance(r, ProgramRule)]
+    program_rules = [r for r in rules if isinstance(r, ProgramRule)]
+    by_relpath = {m.relpath: m for m in mods}
+    raw: list[Finding] = []
+    for rule in per_module:
+        t0 = time.perf_counter()
+        for mod in mods:
+            if rule.applies(mod):
+                raw.extend(rule.check(mod))
+        if timings is not None:
+            timings[rule.name] = timings.get(rule.name, 0.0) + (
+                time.perf_counter() - t0
+            )
+    if program_rules:
+        program = Program(mods)
+        for rule in program_rules:
+            t0 = time.perf_counter()
+            raw.extend(rule.check_program(program))
+            if timings is not None:
+                timings[rule.name] = timings.get(rule.name, 0.0) + (
+                    time.perf_counter() - t0
+                )
     findings: list[Finding] = []
-    for rule in rules if rules is not None else all_rules():
-        if not rule.applies(mod):
+    for f in raw:
+        mod = by_relpath.get(f.path)
+        if mod is not None and mod.suppressions.is_suppressed(f.rule, f.line):
             continue
-        for f in rule.check(mod):
-            if not mod.suppressions.is_suppressed(f.rule, f.line):
-                findings.append(f)
+        findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
+
+
+def lint_module(mod: ModuleInfo, rules: Optional[list[Rule]] = None) -> list[Finding]:
+    """Back-compat single-module entry point (program rules see a
+    one-module program)."""
+    return lint_program([mod], rules)
 
 
 def lint_source(
@@ -281,17 +374,8 @@ def lint_source(
     try:
         mod = ModuleInfo(source, relpath)
     except SyntaxError as e:
-        return [
-            Finding(
-                rule="parse-error",
-                path=relpath.replace(os.sep, "/"),
-                line=e.lineno or 0,
-                col=e.offset or 0,
-                message=f"file does not parse: {e.msg}",
-                context="<module>",
-            )
-        ]
-    return lint_module(mod, rules)
+        return [_parse_error_finding(relpath, e)]
+    return lint_program([mod], rules)
 
 
 def iter_python_files(root: str) -> Iterable[tuple[str, str]]:
@@ -307,18 +391,32 @@ def iter_python_files(root: str) -> Iterable[tuple[str, str]]:
 
 
 def lint_tree(
-    root: Optional[str] = None, rules: Optional[list[Rule]] = None
+    root: Optional[str] = None,
+    rules: Optional[list[Rule]] = None,
+    files: Optional[Iterable[str]] = None,
+    timings: Optional[dict[str, float]] = None,
 ) -> tuple[list[Finding], int]:
     """Lint every Python file under ``root`` (default: the package tree);
-    returns ``(findings, files_scanned)``."""
+    returns ``(findings, files_scanned)``. ``files`` restricts the scan to
+    the given root-relative paths (``--changed``); program rules then see
+    only that subset, so cross-file attribution degrades conservatively."""
     root = root or PACKAGE_ROOT
+    wanted = None if files is None else {p.replace(os.sep, "/") for p in files}
     findings: list[Finding] = []
+    mods: list[ModuleInfo] = []
     n_files = 0
     for full, rel in iter_python_files(root):
+        if wanted is not None and rel not in wanted:
+            continue
         n_files += 1
         with open(full, encoding="utf-8") as f:
             source = f.read()
-        findings.extend(lint_source(source, rel, rules))
+        try:
+            mods.append(ModuleInfo(source, rel, path=full))
+        except SyntaxError as e:
+            findings.append(_parse_error_finding(rel, e))
+    findings.extend(lint_program(mods, rules, timings))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings, n_files
 
 
@@ -423,15 +521,26 @@ class LintResult:
     baselined: list[Finding]
     stale_entries: list[dict[str, Any]]
     files_scanned: int
+    rule_seconds: dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 def run_lint(
     root: Optional[str] = None,
     baseline_path: Optional[str] = None,
     rules: Optional[list[Rule]] = None,
+    files: Optional[Iterable[str]] = None,
 ) -> LintResult:
-    findings, n_files = lint_tree(root, rules)
+    timings: dict[str, float] = {}
+    findings, n_files = lint_tree(root, rules, files=files, timings=timings)
     entries = load_baseline(baseline_path)
+    if files is not None:
+        # A partial scan can neither match nor invalidate baseline entries
+        # for files it never read.
+        scanned = {p.replace(os.sep, "/") for p in files}
+        entries = [e for e in entries if str(e.get("path", "")) in scanned]
+    if rules is not None:
+        active = {r.name for r in rules}
+        entries = [e for e in entries if str(e.get("rule", "")) in active]
     new, baselined, stale = apply_baseline(findings, entries)
     return LintResult(
         findings=findings,
@@ -439,6 +548,7 @@ def run_lint(
         baselined=baselined,
         stale_entries=stale,
         files_scanned=n_files,
+        rule_seconds=timings,
     )
 
 
@@ -466,8 +576,123 @@ def render_json(result: LintResult) -> dict[str, Any]:
         "new_findings": [f.to_dict() for f in result.new],
         "baselined_count": len(result.baselined),
         "stale_baseline_entries": result.stale_entries,
+        "rule_seconds": {
+            name: round(secs, 6)
+            for name, secs in sorted(result.rule_seconds.items())
+        },
         "exit_code": 1 if result.new else 0,
     }
+
+
+def render_sarif(
+    result: LintResult, rules: Optional[list[Rule]] = None
+) -> dict[str, Any]:
+    """SARIF 2.1.0 document over the *new* findings (baselined findings are
+    accepted debt, not review items)."""
+    rule_meta = [
+        {
+            "id": r.name,
+            "shortDescription": {"text": r.description or r.name},
+        }
+        for r in sorted(rules if rules is not None else all_rules(), key=lambda r: r.name)
+    ]
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f"{f.message} [{f.context}]"},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {
+                            "startLine": max(f.line, 1),
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        for f in result.new
+    ]
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "p2plint",
+                        "informationUri": "https://example.invalid/p2pdl-tpu",
+                        "rules": rule_meta,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+
+
+def changed_files(root: str) -> list[str]:
+    """Root-relative ``.py`` files touched vs HEAD (staged, unstaged, and
+    untracked) for ``cli lint --changed``. Raises RuntimeError when git is
+    unusable — the caller turns that into a usage error, not a clean run."""
+    root = os.path.abspath(root)
+    try:
+        top = subprocess.run(
+            ["git", "-C", root, "rev-parse", "--show-toplevel"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+            check=False,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise RuntimeError(f"git unavailable for --changed: {e}") from e
+    if top.returncode != 0:
+        raise RuntimeError(
+            f"--changed needs a git checkout: {top.stderr.strip() or 'rev-parse failed'}"
+        )
+    toplevel = top.stdout.strip()
+    out: set[str] = set()
+    for argv in (
+        ["git", "-C", root, "diff", "--name-only", "HEAD", "--"],
+        # --full-name: ls-files is cwd-relative by default (diff is not).
+        ["git", "-C", root, "ls-files", "--others", "--exclude-standard", "--full-name"],
+    ):
+        try:
+            proc = subprocess.run(
+                argv, capture_output=True, text=True, timeout=30, check=False
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise RuntimeError(f"git unavailable for --changed: {e}") from e
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"`{' '.join(argv)}` failed: {proc.stderr.strip() or proc.returncode}"
+            )
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if not line.endswith(".py"):
+                continue
+            # git paths are repo-root-relative; re-anchor on the lint root.
+            rel = os.path.relpath(os.path.join(toplevel, line), root)
+            if not rel.startswith(".."):
+                out.add(rel.replace(os.sep, "/"))
+    return sorted(out)
+
+
+def resolve_rules(only: Optional[str]) -> Optional[list[Rule]]:
+    """``--only a,b`` -> rule instances; unknown names raise ValueError."""
+    if not only:
+        return None
+    names = [n.strip() for n in only.split(",") if n.strip()]
+    by_name = {r.name: r for r in all_rules()}
+    unknown = [n for n in names if n not in by_name]
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s): {', '.join(unknown)} "
+            f"(known: {', '.join(sorted(by_name))})"
+        )
+    return [by_name[n] for n in names]
 
 
 def cli_lint(
@@ -475,18 +700,51 @@ def cli_lint(
     baseline_path: Optional[str] = None,
     json_out: bool = False,
     write_baseline: bool = False,
+    sarif_out: bool = False,
+    only: Optional[str] = None,
+    changed: bool = False,
 ) -> int:
     """The ``p2pdl_tpu.cli lint`` implementation. Exit 0 iff the tree is
     clean modulo the baseline (stale entries print as warnings but do not
-    fail the CLI — the gate test is the strict consumer)."""
+    fail the CLI — the gate test is the strict consumer); exit 2 on usage
+    errors. The exit-code matrix for findings is unchanged by ``--only`` /
+    ``--changed`` / ``--sarif``."""
     baseline_path = baseline_path or DEFAULT_BASELINE_PATH
-    result = run_lint(root, baseline_path)
+    try:
+        rules = resolve_rules(only)
+    except ValueError as e:
+        print(f"p2plint: {e}")
+        return 2
+    files: Optional[list[str]] = None
+    if changed:
+        try:
+            files = changed_files(root or PACKAGE_ROOT)
+        except RuntimeError as e:
+            print(f"p2plint: {e}")
+            return 2
+    if write_baseline and (rules is not None or files is not None):
+        # A partial scan would silently drop every out-of-scope entry.
+        print("p2plint: --write-baseline cannot combine with --only/--changed")
+        return 2
+    result = run_lint(root, baseline_path, rules=rules, files=files)
     if write_baseline:
         existing = load_baseline(baseline_path)
+        current = {f.fingerprint() for f in result.findings}
+        pruned = [e for e in existing if _entry_fp(e) not in current]
         n = write_baseline_file(baseline_path, result.findings, existing)
-        print(f"p2plint: wrote {n} baseline entr(y/ies) to {baseline_path}")
+        for e in pruned:
+            print(
+                f"p2plint: pruned stale baseline entry: {e.get('rule')} @ "
+                f"{e.get('path')} [{e.get('context')}]: {e.get('message')}"
+            )
+        print(
+            f"p2plint: wrote {n} baseline entr(y/ies) to {baseline_path}"
+            + (f" ({len(pruned)} pruned)" if pruned else "")
+        )
         return 0
-    if json_out:
+    if sarif_out:
+        print(json.dumps(render_sarif(result, rules), indent=2))
+    elif json_out:
         print(json.dumps(render_json(result), indent=2))
     else:
         print(render_text(result))

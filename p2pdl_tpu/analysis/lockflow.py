@@ -1,0 +1,491 @@
+"""Whole-program lock rules: shared lock model, membership, lock order.
+
+:class:`LockModel` computes, once per lint run and shared by every lock
+rule (including the upgraded ``lock-discipline``):
+
+- which classes own which lock attributes (``self._lock = Lock()``) and
+  which modules own lock globals, with the factory recorded so reentrant
+  ``RLock`` can be told apart from ``Lock``;
+- for every function, the set of lock identities lexically held around
+  every node, plus each acquisition event (``with self._lock:``);
+- call-graph attribution (:func:`dataflow.always_locked`): whether a
+  function is entered with a given lock held on *every* resolved path.
+
+Lock identity is ``(class, attr)`` for instance locks (instances of the
+same class collapse — acquiring two instances' locks in any order is
+already an ordering hazard) and ``(module, name)`` for globals. An
+attribute acquisition on a non-``self`` object (``state.lock``) resolves
+only when exactly one class in the program owns a lock attribute of that
+name; ambiguous names (every class calls it ``_lock``) resolve to
+nothing rather than to a guess.
+
+Rules:
+
+- ``lock-membership`` — ROADMAP item 5's invariant: membership state
+  (peers / members / trainers / stopped / suspected) of a lock-owning
+  class may only mutate with that lock held, lexically or via call-graph
+  attribution; and never from outside the owning class (the cross-object
+  ``node.cluster._stopped.add(...)`` shape — route it through a
+  lock-holding method instead).
+- ``lock-order`` — builds the acquired-while-holding digraph across
+  acquisition sites (including locks taken transitively through resolved
+  calls) and flags cycles, plus self-re-acquisition of a non-reentrant
+  ``Lock``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from p2pdl_tpu.analysis import dataflow
+from p2pdl_tpu.analysis.callgraph import FunctionNode
+from p2pdl_tpu.analysis.engine import (
+    Finding,
+    ModuleInfo,
+    Program,
+    ProgramRule,
+    register,
+)
+from p2pdl_tpu.analysis.locks import _LOCK_FACTORIES, _MUTATORS, _self_attr
+
+#: Attribute names that hold membership state in a lock-owning class.
+_MEMBERSHIP_RE = re.compile(
+    r"(^|_)(peer|peers|member|members|membership|trainer|trainers|"
+    r"stopped|suspected|role|roles)(_|$)"
+)
+
+_AMBIGUOUS = ("<ambiguous>",)
+
+
+def _class_qual(mod: ModuleInfo, cls: ast.ClassDef) -> str:
+    # ``context_of`` on a class node is its own qualname already.
+    return mod.context_of(cls)
+
+
+def own_nodes(fn: FunctionNode) -> Iterable[ast.AST]:
+    """Walk a function body without descending into nested defs (those
+    are separate call-graph nodes and would double-report)."""
+
+    def rec(node: ast.AST) -> Iterable[ast.AST]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            yield child
+            yield from rec(child)
+
+    for st in fn.node.body:
+        yield st
+        yield from rec(st)
+
+
+class LockModel:
+    def __init__(self, program: Program) -> None:
+        self.program = program
+        self.graph = program.callgraph
+        #: (relpath, cls_qual) -> {lock_attr: factory_dotted}
+        self.class_locks: dict[tuple[str, str], dict[str, str]] = {}
+        #: relpath -> {global_name: factory_dotted}
+        self.module_locks: dict[str, dict[str, str]] = {}
+        #: lock attr name -> unique (relpath, cls_qual) owner or _AMBIGUOUS
+        self._lock_attr_owner: dict[str, tuple] = {}
+        #: fn key -> {id(node): frozenset(lock ids held)}
+        self.node_held: dict[str, dict[int, frozenset]] = {}
+        #: fn key -> [(lock_id, acquire_expr, held_before)]
+        self.acquires: dict[str, list[tuple]] = {}
+        self._safe_cache: dict[tuple, set[str]] = {}
+        self._collect_owners()
+        self._scan_functions()
+
+    # -- ownership ---------------------------------------------------------
+
+    def _collect_owners(self) -> None:
+        for mod in self.program.mods:
+            for node in mod.walk():
+                if isinstance(node, ast.ClassDef):
+                    attrs: dict[str, str] = {}
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Assign) and isinstance(
+                            sub.value, ast.Call
+                        ):
+                            factory = mod.dotted(sub.value.func)
+                            if factory in _LOCK_FACTORIES:
+                                for t in sub.targets:
+                                    attr = _self_attr(t)
+                                    if attr is not None:
+                                        attrs[attr] = factory
+                    if attrs:
+                        key = (mod.relpath, _class_qual(mod, node))
+                        self.class_locks[key] = attrs
+                        for attr in attrs:
+                            if attr in self._lock_attr_owner:
+                                self._lock_attr_owner[attr] = _AMBIGUOUS
+                            else:
+                                self._lock_attr_owner[attr] = key
+            globs: dict[str, str] = {}
+            for st in mod.tree.body:
+                if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call):
+                    factory = mod.dotted(st.value.func)
+                    if factory in _LOCK_FACTORIES:
+                        for t in st.targets:
+                            if isinstance(t, ast.Name):
+                                globs[t.id] = factory
+            if globs:
+                self.module_locks[mod.relpath] = globs
+
+    def lock_factory(self, lid: tuple) -> Optional[str]:
+        if lid[0] == "C":
+            return self.class_locks.get((lid[1], lid[2]), {}).get(lid[3])
+        return self.module_locks.get(lid[1], {}).get(lid[2])
+
+    def display(self, lid: tuple) -> str:
+        if lid[0] == "C":
+            return f"{lid[2]}.{lid[3]}"
+        return lid[2]
+
+    def class_lock_ids(self, relpath: str, cls_qual: str) -> list[tuple]:
+        return [
+            ("C", relpath, cls_qual, attr)
+            for attr in sorted(self.class_locks.get((relpath, cls_qual), {}))
+        ]
+
+    # -- per-function lexical state ---------------------------------------
+
+    def _lock_id_resolver(self, fn: FunctionNode):
+        def lock_id(expr: ast.AST) -> Optional[tuple]:
+            attr = _self_attr(expr)
+            if attr is not None:
+                if fn.cls is not None and attr in self.class_locks.get(
+                    (fn.relpath, fn.cls), {}
+                ):
+                    return ("C", fn.relpath, fn.cls, attr)
+                return None
+            if isinstance(expr, ast.Name):
+                if expr.id in self.module_locks.get(fn.relpath, {}):
+                    return ("G", fn.relpath, expr.id)
+                return None
+            if isinstance(expr, ast.Attribute):
+                owner = self._lock_attr_owner.get(expr.attr)
+                if owner is not None and owner != _AMBIGUOUS:
+                    return ("C", owner[0], owner[1], expr.attr)
+            return None
+
+        return lock_id
+
+    def _scan_functions(self) -> None:
+        for key, fn in self.graph.functions.items():
+            held_map: dict[int, frozenset] = {}
+            acq: list[tuple] = []
+            for ev in dataflow.iter_lock_states(
+                fn.node.body,
+                self._lock_id_resolver(fn),
+                descend_closures=False,
+            ):
+                if ev[0] == "node":
+                    held_map[id(ev[1])] = ev[2]
+                else:
+                    acq.append((ev[1], ev[2], ev[3]))
+            self.node_held[key] = held_map
+            self.acquires[key] = acq
+
+    def held_at(self, fn_key: str, node: ast.AST) -> frozenset:
+        return self.node_held.get(fn_key, {}).get(id(node), frozenset())
+
+    # -- call-graph attribution -------------------------------------------
+
+    def always_locked_for(self, lid: tuple) -> set[str]:
+        if lid not in self._safe_cache:
+            self._safe_cache[lid] = dataflow.always_locked(
+                self.graph,
+                lambda s: lid in self.held_at(s.caller, s.call),
+            )
+        return self._safe_cache[lid]
+
+    def entered_locked(self, fn_key: str, lids: Iterable[tuple]) -> bool:
+        return any(fn_key in self.always_locked_for(lid) for lid in lids)
+
+
+def lock_model_for(program: Program) -> LockModel:
+    model = getattr(program, "_lock_model", None)
+    if model is None:
+        model = LockModel(program)
+        program._lock_model = model
+    return model
+
+
+# ---- write-site extraction ---------------------------------------------------
+
+
+def _write_targets(node: ast.AST) -> list[ast.AST]:
+    """Attribute-or-subscript write targets of one node (assignments and
+    in-place mutator calls)."""
+    out: list[ast.AST] = []
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            out.append(t.value if isinstance(t, ast.Subscript) else t)
+    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if node.func.attr in _MUTATORS:
+            base = node.func.value
+            out.append(base.value if isinstance(base, ast.Subscript) else base)
+    return out
+
+
+def _attr_and_base(target: ast.AST) -> tuple[Optional[str], Optional[ast.AST]]:
+    """``<base>.<attr>`` -> (attr, base); None for non-attribute targets."""
+    if isinstance(target, ast.Attribute):
+        return target.attr, target.value
+    return None, None
+
+
+# ---- lock-membership ----------------------------------------------------------
+
+
+class MembershipLockRule(ProgramRule):
+    name = "lock-membership"
+    description = (
+        "membership state of a lock-owning class mutated without its lock "
+        "(ROADMAP item 5: membership mutations only under the cluster lock)"
+    )
+    scope = None  # everywhere
+
+    def check_program(self, program: Program) -> Iterable[Finding]:
+        model = lock_model_for(program)
+        # membership attr -> owning (relpath, cls_qual); ambiguous removed.
+        owners: dict[str, tuple] = {}
+        members_of: dict[tuple, set[str]] = {}
+        for (relpath, cls_qual), _locks in model.class_locks.items():
+            mod = program.module(relpath)
+            if mod is None or not self.applies(mod):
+                continue
+            attrs = self._membership_attrs(model, relpath, cls_qual)
+            if not attrs:
+                continue
+            members_of[(relpath, cls_qual)] = attrs
+            for a in attrs:
+                owners[a] = _AMBIGUOUS if a in owners else (relpath, cls_qual)
+        owners = {a: o for a, o in owners.items() if o != _AMBIGUOUS}
+
+        findings: list[Finding] = []
+        findings.extend(self._check_intra(program, model, members_of))
+        findings.extend(self._check_cross(program, model, owners))
+        return findings
+
+    def _membership_attrs(
+        self, model: LockModel, relpath: str, cls_qual: str
+    ) -> set[str]:
+        attrs: set[str] = set()
+        lock_attrs = set(model.class_locks.get((relpath, cls_qual), {}))
+        for fn in model.graph.functions.values():
+            if fn.relpath != relpath or not self._in_class(fn, cls_qual):
+                continue
+            for node in own_nodes(fn):
+                for target in _write_targets(node):
+                    attr = _self_attr(target)
+                    if (
+                        attr is not None
+                        and attr not in lock_attrs
+                        and _MEMBERSHIP_RE.search(attr)
+                    ):
+                        attrs.add(attr)
+        return attrs
+
+    @staticmethod
+    def _in_class(fn: FunctionNode, cls_qual: str) -> bool:
+        return fn.cls == cls_qual or fn.qualname.startswith(cls_qual + ".")
+
+    def _check_intra(
+        self,
+        program: Program,
+        model: LockModel,
+        members_of: dict[tuple, set[str]],
+    ) -> Iterable[Finding]:
+        for (relpath, cls_qual), attrs in sorted(members_of.items()):
+            mod = program.module(relpath)
+            lids = model.class_lock_ids(relpath, cls_qual)
+            lock_display = model.display(lids[0]) if lids else "its lock"
+            for fn in model.graph.functions.values():
+                if fn.relpath != relpath or not self._in_class(fn, cls_qual):
+                    continue
+                if fn.qualname == f"{cls_qual}.__init__":
+                    continue  # not yet shared across threads
+                entered = model.entered_locked(fn.key, lids)
+                for node in own_nodes(fn):
+                    for target in _write_targets(node):
+                        attr = _self_attr(target)
+                        if attr is None or attr not in attrs:
+                            continue
+                        held = model.held_at(fn.key, node)
+                        if any(l in held for l in lids) or entered:
+                            continue
+                        yield mod.finding(
+                            self.name,
+                            node,
+                            f"membership state `self.{attr}` of `{cls_qual}` "
+                            f"is mutated without `{lock_display}` held on "
+                            "every path into "
+                            f"`{fn.qualname}`",
+                        )
+
+    def _check_cross(
+        self,
+        program: Program,
+        model: LockModel,
+        owners: dict[str, tuple],
+    ) -> Iterable[Finding]:
+        if not owners:
+            return
+        for mod in program.mods:
+            if not self.applies(mod):
+                continue
+            for node in mod.walk():
+                for target in _write_targets(node):
+                    attr, base = _attr_and_base(target)
+                    if attr is None or attr not in owners:
+                        continue
+                    if isinstance(base, ast.Name) and base.id == "self":
+                        continue  # intra-class: _check_intra's job
+                    relpath, cls_qual = owners[attr]
+                    ctx = mod.context_of(node)
+                    if mod.relpath == relpath and (
+                        ctx == cls_qual or ctx.startswith(cls_qual + ".")
+                    ):
+                        continue  # still inside the owning class
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        f"membership state `.{attr}` of `{cls_qual}` is "
+                        "mutated from outside the owning class — route it "
+                        f"through a `{cls_qual}` method that holds its lock",
+                    )
+
+
+# ---- lock-order ---------------------------------------------------------------
+
+
+class LockOrderRule(ProgramRule):
+    name = "lock-order"
+    description = "inconsistent lock acquisition order across call paths"
+    scope = None  # everywhere
+
+    def check_program(self, program: Program) -> Iterable[Finding]:
+        model = lock_model_for(program)
+        graph = program.callgraph
+        direct = {
+            k: frozenset(lid for lid, _, _ in model.acquires.get(k, ()))
+            for k in graph.functions
+        }
+        trans = dataflow.transitive_acquires(graph, direct)
+
+        # edges[a][b] = representative site where b is acquired with a held
+        edges: dict[tuple, dict[tuple, tuple[str, ast.AST]]] = {}
+
+        def add_edge(a: tuple, b: tuple, relpath: str, site: ast.AST) -> None:
+            cur = edges.setdefault(a, {})
+            prev = cur.get(b)
+            key = (relpath, getattr(site, "lineno", 0))
+            if prev is None or (prev[0], getattr(prev[1], "lineno", 0)) > key:
+                cur[b] = (relpath, site)
+
+        for k, fn in graph.functions.items():
+            for lid, expr, held_before in model.acquires.get(k, ()):
+                for h in held_before:
+                    add_edge(h, lid, fn.relpath, expr)
+            for site in graph.callees_of(k):
+                held = model.held_at(k, site.call)
+                if not held:
+                    continue
+                for l2 in trans.get(site.callee, ()):
+                    for h in held:
+                        if h == l2 and model.lock_factory(h) != "threading.Lock":
+                            continue  # reentrant: re-acquiring is fine
+                        add_edge(h, l2, fn.relpath, site.call)
+
+        yield from self._cycle_findings(program, model, edges)
+
+    def _cycle_findings(
+        self,
+        program: Program,
+        model: LockModel,
+        edges: dict[tuple, dict[tuple, tuple[str, ast.AST]]],
+    ) -> Iterable[Finding]:
+        # Self-loops: re-acquiring a non-reentrant lock while held.
+        for a, outs in sorted(edges.items()):
+            if a in outs:
+                relpath, site = outs[a]
+                mod = program.module(relpath)
+                if mod is not None:
+                    yield mod.finding(
+                        self.name,
+                        site,
+                        f"non-reentrant lock `{model.display(a)}` may be "
+                        "re-acquired while already held (self-deadlock)",
+                    )
+        # Multi-lock cycles: strongly connected components of size > 1.
+        for scc in _sccs({a: set(outs) for a, outs in edges.items()}):
+            if len(scc) < 2:
+                continue
+            names = sorted(model.display(l) for l in scc)
+            # Deterministic anchor: the earliest edge site inside the SCC.
+            sites = [
+                edges[a][b]
+                for a in scc
+                for b in edges.get(a, {})
+                if b in scc and b != a
+            ]
+            if not sites:
+                continue
+            relpath, site = min(
+                sites, key=lambda s: (s[0], getattr(s[1], "lineno", 0))
+            )
+            mod = program.module(relpath)
+            if mod is None:
+                continue
+            yield mod.finding(
+                self.name,
+                site,
+                f"lock-order cycle among {', '.join(f'`{n}`' for n in names)}: "
+                "inconsistent acquisition order across call paths can deadlock",
+            )
+
+
+def _sccs(adj: dict) -> list[set]:
+    """Tarjan strongly-connected components over a small digraph."""
+    index: dict = {}
+    low: dict = {}
+    on_stack: set = set()
+    stack: list = []
+    out: list[set] = []
+    counter = [0]
+    nodes = set(adj) | {b for outs in adj.values() for b in outs}
+
+    def strongconnect(v) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in adj.get(v, ()):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = set()
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.add(w)
+                if w == v:
+                    break
+            out.append(comp)
+
+    for v in sorted(nodes):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+register(MembershipLockRule())
+register(LockOrderRule())

@@ -9,6 +9,13 @@ written *both* inside and outside the lock — the mixed case is the bug
 single-threaded by design and produces no finding; requiring both sides
 keeps the rule's false-positive rate near zero).
 
+Since PR 10 the rule is interprocedural: a lexically-unlocked write is
+exonerated when its enclosing method provably runs with the lock held on
+*every* resolved call path (``with self._lock: self._flush()`` calling a
+helper that writes without its own ``with``). Attribution comes from the
+shared :mod:`lockflow` lock model over the conservative call graph —
+entry points and dynamically-dispatched calls are never exonerated.
+
 Tracked writes: ``self.x = ...``, ``self.x += ...``, ``self.x[...] = ...``
 and in-place mutator calls (``self.x.append(...)``, ``.pop()``,
 ``.update()`` ...). ``__init__`` is exempt (the object is not yet shared).
@@ -22,7 +29,13 @@ from __future__ import annotations
 import ast
 from typing import Iterable, Optional
 
-from p2pdl_tpu.analysis.engine import Finding, ModuleInfo, Rule, register
+from p2pdl_tpu.analysis.engine import (
+    Finding,
+    ModuleInfo,
+    Program,
+    ProgramRule,
+    register,
+)
 
 _LOCK_FACTORIES = {
     "threading.Lock",
@@ -143,19 +156,30 @@ def _scan_stmts(
             _writes_in_stmt(st, attr_of, log, locked)
 
 
-class LockDisciplineRule(Rule):
+class LockDisciplineRule(ProgramRule):
     name = "lock-discipline"
     description = "shared attribute written both with and without its lock"
     scope = None  # everywhere
 
-    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
-        yield from self._check_classes(mod)
-        yield from self._check_module_globals(mod)
+    def check_program(self, program: Program) -> Iterable[Finding]:
+        from p2pdl_tpu.analysis.lockflow import lock_model_for
+
+        model = lock_model_for(program)
+        for mod in program.mods:
+            yield from self._check_classes(mod, model)
+            yield from self._check_module_globals(mod, model)
+
+    @staticmethod
+    def _site_exonerated(mod: ModuleInfo, model, node: ast.AST, lids) -> bool:
+        """A lexically-unlocked write is fine when its enclosing function
+        only ever runs with the lock held (call-graph attribution)."""
+        fn_key = f"{mod.relpath}::{mod.context_of(node)}"
+        return model.entered_locked(fn_key, lids)
 
     # -- classes with self._lock ------------------------------------------
 
-    def _check_classes(self, mod: ModuleInfo) -> Iterable[Finding]:
-        for cls in ast.walk(mod.tree):
+    def _check_classes(self, mod: ModuleInfo, model) -> Iterable[Finding]:
+        for cls in mod.walk():
             if not isinstance(cls, ast.ClassDef):
                 continue
             lock_attrs: set[str] = set()
@@ -168,6 +192,8 @@ class LockDisciplineRule(Rule):
                                 lock_attrs.add(attr)
             if not lock_attrs:
                 continue
+            # context_of on a class node is its own qualname already.
+            lids = model.class_lock_ids(mod.relpath, mod.context_of(cls))
 
             def attr_of(expr: ast.AST) -> Optional[str]:
                 attr = _self_attr(expr)
@@ -184,7 +210,14 @@ class LockDisciplineRule(Rule):
                 _scan_stmts(item.body, attr_of, log, False, lock_attrs, set())
             lock_name = sorted(lock_attrs)[0]
             for attr in sorted(set(log.inside) & set(log.outside)):
-                first = min(log.outside[attr], key=lambda n: getattr(n, "lineno", 0))
+                remaining = [
+                    n
+                    for n in log.outside[attr]
+                    if not self._site_exonerated(mod, model, n, lids)
+                ]
+                if not remaining:
+                    continue
+                first = min(remaining, key=lambda n: getattr(n, "lineno", 0))
                 yield mod.finding(
                     self.name,
                     first,
@@ -194,7 +227,7 @@ class LockDisciplineRule(Rule):
 
     # -- module-level LOCK = threading.Lock() globals ----------------------
 
-    def _check_module_globals(self, mod: ModuleInfo) -> Iterable[Finding]:
+    def _check_module_globals(self, mod: ModuleInfo, model) -> Iterable[Finding]:
         lock_globals: set[str] = set()
         for st in mod.tree.body:
             if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call):
@@ -204,9 +237,10 @@ class LockDisciplineRule(Rule):
                             lock_globals.add(t.id)
         if not lock_globals:
             return
+        lids = [("G", mod.relpath, name) for name in sorted(lock_globals)]
 
         log = _WriteLog()
-        for fn in ast.walk(mod.tree):
+        for fn in mod.walk():
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             declared: set[str] = set()
@@ -225,7 +259,14 @@ class LockDisciplineRule(Rule):
             _scan_stmts(fn.body, attr_of, log, False, set(), lock_globals)
         lock_name = sorted(lock_globals)[0]
         for name in sorted(set(log.inside) & set(log.outside)):
-            first = min(log.outside[name], key=lambda n: getattr(n, "lineno", 0))
+            remaining = [
+                n
+                for n in log.outside[name]
+                if not self._site_exonerated(mod, model, n, lids)
+            ]
+            if not remaining:
+                continue
+            first = min(remaining, key=lambda n: getattr(n, "lineno", 0))
             yield mod.finding(
                 self.name,
                 first,

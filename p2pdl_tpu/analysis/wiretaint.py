@@ -1,0 +1,210 @@
+"""wire-taint rule: unverified wire bytes must not reach protocol sinks.
+
+This is the static form of the invariant PR 4 was twice caught violating:
+attacker-controlled bytes must be shape-validated and signature-verified
+before they touch protocol state, allocation sizes, or parsers.
+
+- **Sources** — the functions where bytes leave the attacker's hands:
+  ``recv_frame`` / ``_recv_exact`` (raw socket reads), the control-plane
+  parsers ``control_from_wire`` / ``brb_from_wire`` / ``batch_from_wire``
+  (their *outputs* are attacker-shaped objects), and HTTP request bodies
+  (``self.rfile.read``) in the orchestrator.
+- **Sanitizers** — signature verification (``verify`` / ``crypto_ok`` /
+  ``batch_ok``), key-membership checks (``has_key``), and explicit shape
+  validation (comparing a tainted value or its ``len()`` against a
+  constant / ALL-CAPS bound). ``handle_preverified`` is a declared trust
+  boundary: its callers are audited (the batch path verifies first), so
+  taint does not propagate into it.
+- **Sinks** — protocol-state writes (``self.state[...] = ...`` and
+  mutator calls) in protocol/runtime classes, reads or allocations sized
+  by a tainted integer (``read(n)`` / ``recv(n)`` / ``bytearray(n)`` /
+  ``range(n)`` — the 4096x amplification shape), ``struct.unpack``
+  windows positioned by a tainted offset, and ``json.loads`` of an
+  unverified payload.
+
+Source functions are themselves boundaries: the sanctioned parsers are
+not re-flagged for parsing (their callers see fresh taint instead).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Iterable
+
+from p2pdl_tpu.analysis.dataflow import TaintEngine, TaintPolicy
+from p2pdl_tpu.analysis.engine import (
+    Finding,
+    ModuleInfo,
+    Program,
+    ProgramRule,
+    register,
+)
+from p2pdl_tpu.analysis.locks import _MUTATORS, _self_attr
+
+RULE_NAME = "wire-taint"
+
+_SOURCES = frozenset(
+    {
+        "recv_frame",
+        "control_from_wire",
+        "brb_from_wire",
+        "batch_from_wire",
+        "recv_exact",
+        "_recv_exact",
+    }
+)
+_SANITIZERS = frozenset({"verify", "crypto_ok", "batch_ok", "sign_ok", "has_key"})
+_SIZED_READS = frozenset(
+    {"read", "recv", "recvfrom", "recv_exact", "_recv_exact", "read_exact"}
+)
+_SIZED_ALLOCS = frozenset({"bytearray", "range"})
+
+
+def _last_segment(mod: ModuleInfo, func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        dotted = mod.dotted(func) or func.id
+        return dotted.split(".")[-1]
+    return ""
+
+
+class _WirePolicy(TaintPolicy):
+    boundaries = _SOURCES | frozenset({"handle_preverified"})
+
+    def __init__(self, rule: "WireTaintRule") -> None:
+        self.rule = rule
+
+    def in_scope(self, mod: ModuleInfo) -> bool:
+        return self.rule.applies(mod)
+
+    def is_source(self, mod: ModuleInfo, call: ast.Call) -> bool:
+        if _last_segment(mod, call.func) in _SOURCES:
+            return True
+        dotted = mod.dotted(call.func)
+        return bool(dotted and dotted.endswith("rfile.read"))
+
+    def is_sanitizer(self, mod: ModuleInfo, call: ast.Call) -> bool:
+        return _last_segment(mod, call.func) in _SANITIZERS
+
+    # -- sinks -------------------------------------------------------------
+
+    def check_call(
+        self, mod: ModuleInfo, call: ast.Call, tainted: Callable[[ast.AST], bool]
+    ) -> Iterable[Finding]:
+        name = _last_segment(mod, call.func)
+        findings: list[Finding] = []
+        any_arg_tainted = any(tainted(a) for a in call.args) or any(
+            tainted(kw.value) for kw in call.keywords
+        )
+        if name in _SIZED_READS and any_arg_tainted:
+            findings.append(
+                mod.finding(
+                    RULE_NAME,
+                    call,
+                    f"`{name}` sized by an unverified wire integer — bound-check "
+                    "it against a constant cap before reading",
+                )
+            )
+        elif name in _SIZED_ALLOCS and any_arg_tainted:
+            findings.append(
+                mod.finding(
+                    RULE_NAME,
+                    call,
+                    f"`{name}` sized by an unverified wire integer — the "
+                    "amplification shape; validate the count first",
+                )
+            )
+        elif name == "loads" and any_arg_tainted:
+            findings.append(
+                mod.finding(
+                    RULE_NAME,
+                    call,
+                    "json.loads of an unverified wire payload — verify the "
+                    "signature or validate the shape first",
+                )
+            )
+        elif name in ("unpack", "unpack_from"):
+            for arg in call.args:
+                if isinstance(arg, ast.Subscript) and isinstance(
+                    arg.slice, ast.Slice
+                ):
+                    bounds = (arg.slice.lower, arg.slice.upper, arg.slice.step)
+                    if any(b is not None and tainted(b) for b in bounds):
+                        findings.append(
+                            mod.finding(
+                                RULE_NAME,
+                                call,
+                                "struct unpack window positioned by an "
+                                "unverified wire integer",
+                            )
+                        )
+                        break
+            if name == "unpack_from" and len(call.args) >= 3 and tainted(call.args[2]):
+                findings.append(
+                    mod.finding(
+                        RULE_NAME,
+                        call,
+                        "struct unpack_from offset from an unverified wire integer",
+                    )
+                )
+        # In-place protocol-state mutation: self.state.add(tainted) etc.
+        if isinstance(call.func, ast.Attribute) and call.func.attr in _MUTATORS:
+            attr = _self_attr(call.func.value)
+            base = call.func.value
+            if attr is None and isinstance(base, ast.Subscript):
+                attr = _self_attr(base.value)
+            if attr is not None and any_arg_tainted:
+                findings.append(
+                    mod.finding(
+                        RULE_NAME,
+                        call,
+                        f"unverified wire data written into protocol state "
+                        f"`self.{attr}` — verify the signature or validate "
+                        "the shape first",
+                    )
+                )
+        return findings
+
+    def check_write(
+        self,
+        mod: ModuleInfo,
+        node: ast.AST,
+        target: ast.AST,
+        value_tainted: bool,
+        tainted: Callable[[ast.AST], bool],
+    ) -> Iterable[Finding]:
+        base = target.value if isinstance(target, ast.Subscript) else target
+        attr = _self_attr(base)
+        if attr is None:
+            return ()
+        key_tainted = isinstance(target, ast.Subscript) and tainted(target.slice)
+        if not (value_tainted or key_tainted):
+            return ()
+        return [
+            mod.finding(
+                RULE_NAME,
+                node,
+                f"unverified wire data written into protocol state "
+                f"`self.{attr}` — verify the signature or validate the "
+                "shape first",
+            )
+        ]
+
+
+class WireTaintRule(ProgramRule):
+    name = RULE_NAME
+    description = (
+        "wire-derived data reaches protocol state, an allocation size, or a "
+        "parser without signature verification or shape validation"
+    )
+    scope = ("protocol/", "runtime/")
+
+    def check_program(self, program: Program) -> Iterable[Finding]:
+        if not any(self.applies(m) for m in program.mods):
+            return []
+        engine = TaintEngine(program.mods, program.callgraph, _WirePolicy(self))
+        return engine.run()
+
+
+register(WireTaintRule())

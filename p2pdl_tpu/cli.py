@@ -349,6 +349,23 @@ def build_parser() -> argparse.ArgumentParser:
         "p2pdl_tpu package)",
     )
     p.add_argument(
+        "--only", default=None, metavar="RULE[,RULE]",
+        help="lint mode: run only the named rule(s); baseline entries for "
+        "other rules are ignored rather than reported stale. Unknown "
+        "names exit 2",
+    )
+    p.add_argument(
+        "--changed", action="store_true",
+        help="lint mode: lint only .py files changed vs HEAD (plus "
+        "untracked) under the lint root; program rules see just that "
+        "subset, so cross-file attribution degrades conservatively",
+    )
+    p.add_argument(
+        "--sarif", action="store_true",
+        help="lint mode: emit new findings as a SARIF 2.1.0 document "
+        "instead of text/JSON (for code-review tooling)",
+    )
+    p.add_argument(
         "--perf", action="store_true",
         help="enable the cost-model plane: AOT-compile each program once "
         "more to extract XLA FLOPs/HBM-bytes/peak-memory and publish the "
@@ -1192,6 +1209,9 @@ def main(argv: list[str] | None = None) -> int:
             baseline_path=args.baseline,
             json_out=args.lint_json,
             write_baseline=args.write_baseline,
+            sarif_out=args.sarif,
+            only=args.only,
+            changed=args.changed,
         )
     # Every other mode dispatches compiled programs — install the
     # shard_map/pcast aliases if this JAX build needs them (no-op otherwise).

@@ -51,7 +51,7 @@ class Node:
     def start(self) -> None:
         """(Re-)join the cluster: eligible for sampling and consent again
         (reference ``start()`` binds the listener socket)."""
-        self.cluster._stopped.discard(self.node_id)
+        self.cluster._set_stopped(self.node_id, stopped=False)
         flight.record("membership", peer=self.node_id, change="start")
 
     def stop(self) -> None:
@@ -59,7 +59,7 @@ class Node:
         93-95``): a stopped node cannot consent to training, a round that
         sampled it runs with its slot vacated (-1, shrunken participation),
         and its delivery flag never sets. ``start()`` re-admits."""
-        self.cluster._stopped.add(self.node_id)
+        self.cluster._set_stopped(self.node_id, stopped=True)
         flight.record("membership", peer=self.node_id, change="stop")
 
     def connect(self, other: "Node") -> None:
@@ -127,6 +127,16 @@ class Cluster:
             self._expected_trainers = trainers
         testers = [i for i in range(self.cfg.num_peers) if i not in trainers]
         return [self.nodes[i] for i in trainers], [self.nodes[i] for i in testers]
+
+    def _set_stopped(self, node_id: int, stopped: bool) -> None:
+        """Membership mutation, serialized against the quorum check: a
+        concurrent stop() must not interleave with _mark_trainer's
+        live-trainer computation (it reads `_stopped` under this lock)."""
+        with self._lock:
+            if stopped:
+                self._stopped.add(node_id)
+            else:
+                self._stopped.discard(node_id)
 
     def _mark_trainer(self, node_id: int) -> None:
         run_now = False

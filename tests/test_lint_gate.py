@@ -10,6 +10,7 @@ unlocked write to shared hub state fails the suite.
 """
 
 import json
+import subprocess
 import textwrap
 
 import pytest
@@ -134,6 +135,71 @@ BAD_FIXTURES = {
                 return b"|".join(parts)
         """,
     ),
+    # PR 4's forgery, reconstructed at the taint level: a wire batch minted
+    # into protocol vote state without a signature check in between.
+    "wiretaint-forgery": (
+        "protocol/bad_forgery.py",
+        """
+        from p2pdl_tpu.protocol.transport import control_from_wire
+
+        class Broadcaster:
+            def __init__(self):
+                self.readies = {}
+
+            def handle_frame(self, data):
+                batch = control_from_wire(data)
+                for sender, digest in batch.items:
+                    self.readies.setdefault(digest, set()).add(sender)
+        """,
+    ),
+    # The amplification shape: a read sized by an unbounded wire integer.
+    "wiretaint-amplification": (
+        "protocol/bad_amplification.py",
+        """
+        import struct
+        from p2pdl_tpu.protocol.transport import _recv_exact
+
+        def read_frame(sock):
+            header = _recv_exact(sock, 4)
+            (length,) = struct.unpack(">I", header)
+            return _recv_exact(sock, length)
+        """,
+    ),
+    "lock-membership": (
+        "runtime/bad_membership.py",
+        """
+        import threading
+
+        class Cluster:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._peers = set()
+
+            def join(self, pid):
+                self._peers.add(pid)
+        """,
+    ),
+    "lock-order": (
+        "runtime/bad_lock_order.py",
+        """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._lock_a = threading.Lock()
+                self._lock_b = threading.Lock()
+
+            def m1(self):
+                with self._lock_a:
+                    with self._lock_b:
+                        pass
+
+            def m2(self):
+                with self._lock_b:
+                    with self._lock_a:
+                        pass
+        """,
+    ),
 }
 
 
@@ -193,3 +259,201 @@ def test_cli_write_baseline_round_trip(tmp_path, capsys):
     assert cli_main(lint_args) == 0
     out = capsys.readouterr().out
     assert "1 baselined" in out
+
+
+def _write_fixture(tmp_path, family):
+    relpath, src = BAD_FIXTURES[family]
+    target = tmp_path / relpath
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(src))
+    return relpath
+
+
+def test_cli_lint_flags_forgery_fixture_as_wiretaint(tmp_path, capsys):
+    """Acceptance: the reconstructed PR 4 forgery exits nonzero under the
+    interprocedural wire-taint rule specifically."""
+    _write_fixture(tmp_path, "wiretaint-forgery")
+    rc = cli_main(
+        ["lint", "--json", "--lint-root", str(tmp_path), "--baseline",
+         str(tmp_path / "no-baseline.json")]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["rule"] for f in doc["new_findings"]} == {"wire-taint"}
+    assert "protocol state" in doc["new_findings"][0]["message"]
+
+
+def test_cli_lint_flags_amplification_fixture_as_wiretaint(tmp_path, capsys):
+    _write_fixture(tmp_path, "wiretaint-amplification")
+    rc = cli_main(
+        ["lint", "--json", "--lint-root", str(tmp_path), "--baseline",
+         str(tmp_path / "no-baseline.json")]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["rule"] for f in doc["new_findings"]} == {"wire-taint"}
+    assert "unverified wire integer" in doc["new_findings"][0]["message"]
+
+
+# ---- --only -----------------------------------------------------------------
+
+
+def test_cli_lint_only_scopes_the_rule_set(tmp_path, capsys):
+    # A tree that is bad under two different families...
+    _write_fixture(tmp_path, "determinism")
+    _write_fixture(tmp_path, "lock-order")
+    base = ["lint", "--json", "--lint-root", str(tmp_path), "--baseline",
+            str(tmp_path / "no-baseline.json")]
+    assert cli_main(base + ["--only", "lock-order"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in doc["new_findings"]} == {"lock-order"}
+    # ...passes clean when --only selects a family it does not violate.
+    assert cli_main(base + ["--only", "wire-taint,lock-membership"]) == 0
+
+
+def test_cli_lint_only_unknown_rule_is_a_usage_error(tmp_path, capsys):
+    rc = cli_main(["lint", "--lint-root", str(tmp_path), "--only", "no-such-rule"])
+    assert rc == 2
+    assert "unknown rule" in capsys.readouterr().out
+
+
+def test_cli_lint_write_baseline_refuses_scoped_runs(tmp_path, capsys):
+    rc = cli_main(
+        ["lint", "--lint-root", str(tmp_path), "--write-baseline", "--only",
+         "lock-order"]
+    )
+    assert rc == 2
+
+
+# ---- --changed --------------------------------------------------------------
+
+
+def _git(tmp_path, *args):
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=tmp_path, check=True, capture_output=True,
+    )
+
+
+def test_cli_lint_changed_scopes_to_dirty_files(tmp_path, capsys):
+    _write_fixture(tmp_path, "determinism")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    base = ["lint", "--json", "--lint-root", str(tmp_path), "--baseline",
+            str(tmp_path / "no-baseline.json")]
+    # Committed bad file, clean working tree: --changed scans nothing.
+    assert cli_main(base + ["--changed"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["files_scanned"] == 0
+    # An untracked bad file IS picked up...
+    relpath = _write_fixture(tmp_path, "lock-order")
+    assert cli_main(base + ["--changed"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in doc["new_findings"]} == {"lock-order"}
+    assert {f["path"] for f in doc["new_findings"]} == {relpath}
+    # ...while the full (unscoped) run still sees both bad families.
+    assert cli_main(base) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in doc["new_findings"]} == {
+        "determinism-wallclock", "lock-order",
+    }
+
+
+def test_cli_lint_changed_anchors_untracked_files_under_a_subdir_root(
+    tmp_path, capsys
+):
+    """Regression: `git ls-files --others` prints cwd-relative paths (diff
+    prints toplevel-relative ones), so with the lint root a subdirectory of
+    the checkout — the shipped default, `p2pdl_tpu/` — untracked files were
+    mis-anchored and silently skipped."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "seed.py").write_text("X = 1\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    relpath = _write_fixture(pkg, "lock-order")  # untracked, under pkg/
+    rc = cli_main(
+        ["lint", "--json", "--changed", "--lint-root", str(pkg), "--baseline",
+         str(tmp_path / "no-baseline.json")]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["path"] for f in doc["new_findings"]} == {relpath}
+
+
+def test_cli_lint_changed_outside_a_repo_is_an_error(tmp_path, capsys):
+    rc = cli_main(["lint", "--lint-root", str(tmp_path), "--changed"])
+    assert rc == 2
+
+
+# ---- --sarif ----------------------------------------------------------------
+
+
+def test_cli_lint_sarif_output_shape(tmp_path, capsys):
+    relpath = _write_fixture(tmp_path, "wiretaint-forgery")
+    rc = cli_main(
+        ["lint", "--sarif", "--lint-root", str(tmp_path), "--baseline",
+         str(tmp_path / "no-baseline.json")]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "p2plint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"wire-taint", "lock-membership", "lock-order"} <= rule_ids
+    res = run["results"][0]
+    assert res["ruleId"] == "wire-taint"
+    assert res["level"] == "error"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == relpath
+    assert loc["region"]["startLine"] > 0
+    assert loc["region"]["startColumn"] > 0
+
+
+def test_cli_lint_sarif_clean_tree_has_no_results(capsys):
+    assert cli_main(["lint", "--sarif"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["results"] == []
+
+
+# ---- per-rule timings -------------------------------------------------------
+
+
+def test_cli_lint_json_reports_per_rule_seconds(capsys):
+    assert cli_main(["lint", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    seconds = doc["rule_seconds"]
+    assert {"wire-taint", "lock-discipline", "lock-membership", "lock-order"} <= set(
+        seconds
+    )
+    assert all(v >= 0 for v in seconds.values())
+
+
+# ---- baseline staleness pruning --------------------------------------------
+
+
+def test_write_baseline_prunes_stale_entries_and_reports_them(tmp_path, capsys):
+    target = tmp_path / _write_fixture(tmp_path, "determinism")
+    baseline = str(tmp_path / "baseline.json")
+    lint_args = ["lint", "--lint-root", str(tmp_path), "--baseline", baseline]
+    assert cli_main(lint_args + ["--write-baseline"]) == 0
+    capsys.readouterr()
+    assert cli_main(lint_args) == 0  # baselined
+    # Fix the file: the entry is now stale, and a rewrite must prune it.
+    target.write_text("import time\n\ndef stamp():\n    return time.perf_counter()\n")
+    assert cli_main(lint_args) == 0
+    assert "1 stale" in capsys.readouterr().out
+    assert cli_main(lint_args + ["--write-baseline"]) == 0
+    out = capsys.readouterr().out
+    assert "pruned stale baseline entry" in out
+    assert "determinism-wallclock" in out
+    assert "(1 pruned)" in out
+    # Round-trip: the pruned baseline matches the clean tree exactly.
+    assert cli_main(lint_args) == 0
+    out = capsys.readouterr().out
+    assert "0 baselined" in out and "0 stale" in out
+    doc = json.loads((tmp_path / "baseline.json").read_text())
+    assert doc["entries"] == []

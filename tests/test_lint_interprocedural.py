@@ -1,0 +1,645 @@
+"""PR 10 interprocedural p2plint: call-graph construction, wire-taint
+source->sink tracking across call boundaries, and the whole-program lock
+family (cross-call attribution, membership discipline, lock ordering).
+
+Every rule gets a known-good / known-bad fixture pair; the bad twin
+reconstructs a real failure shape (the PR 4 batch forgery, the
+length-field amplification, the Cluster membership race this PR fixed).
+Pure tier-1: in-memory sources only, no jax.
+"""
+
+import textwrap
+
+import pytest
+
+from p2pdl_tpu.analysis.callgraph import build_callgraph
+from p2pdl_tpu.analysis.engine import ModuleInfo, lint_program, lint_source
+
+
+def lint(src: str, relpath: str = "protocol/fake.py"):
+    return lint_source(textwrap.dedent(src), relpath)
+
+
+def lint_mods(*mods: tuple[str, str]):
+    return lint_program([ModuleInfo(textwrap.dedent(src), rel) for rel, src in mods])
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---- call graph -------------------------------------------------------------
+
+
+def graph_of(*mods: tuple[str, str]):
+    return build_callgraph(
+        [ModuleInfo(textwrap.dedent(src), rel) for rel, src in mods]
+    )
+
+
+def edges_of(graph, caller_key):
+    return {site.callee for site in graph.callees_of(caller_key)}
+
+
+def test_callgraph_resolves_same_module_and_self_calls():
+    g = graph_of(
+        (
+            "protocol/a.py",
+            """
+            def helper(x):
+                return x
+
+            def top(x):
+                return helper(x)
+
+            class C:
+                def run(self):
+                    return self.step()
+                def step(self):
+                    return 1
+            """,
+        )
+    )
+    assert edges_of(g, "protocol/a.py::top") == {"protocol/a.py::helper"}
+    assert edges_of(g, "protocol/a.py::C.run") == {"protocol/a.py::C.step"}
+
+
+def test_callgraph_resolves_class_qualified_and_constructor_calls():
+    g = graph_of(
+        (
+            "protocol/a.py",
+            """
+            class C:
+                def __init__(self):
+                    self.x = 0
+                def step(self):
+                    return 1
+
+            def make():
+                c = C()
+                return C.step(c)
+            """,
+        )
+    )
+    assert edges_of(g, "protocol/a.py::make") == {
+        "protocol/a.py::C.__init__",
+        "protocol/a.py::C.step",
+    }
+
+
+def test_callgraph_resolves_cross_module_imports_with_and_without_prefix():
+    transport = (
+        "protocol/transport.py",
+        """
+        def recv_frame(sock):
+            return sock.read()
+        """,
+    )
+    for import_line in (
+        "from p2pdl_tpu.protocol.transport import recv_frame",
+        "from protocol.transport import recv_frame",
+        "from p2pdl_tpu.protocol import transport",
+    ):
+        call = "recv_frame(s)" if "import recv_frame" in import_line else "transport.recv_frame(s)"
+        g = graph_of(
+            transport,
+            (
+                "runtime/user.py",
+                f"""
+                {import_line}
+
+                def pull(s):
+                    return {call}
+                """,
+            ),
+        )
+        assert edges_of(g, "runtime/user.py::pull") == {
+            "protocol/transport.py::recv_frame"
+        }, import_line
+
+
+def test_callgraph_leaves_dynamic_and_module_level_calls_unresolved():
+    g = graph_of(
+        (
+            "protocol/a.py",
+            """
+            def helper():
+                return 1
+
+            class C:
+                def run(self):
+                    return self.handler()  # attribute, not a defined method
+
+            TABLE = helper()  # module-level: import-time, not tracked
+            """,
+        )
+    )
+    assert edges_of(g, "protocol/a.py::C.run") == set()
+    assert g.callers_of("protocol/a.py::helper") == []
+
+
+def test_callgraph_param_names_skip_self():
+    g = graph_of(
+        (
+            "protocol/a.py",
+            """
+            class C:
+                def m(self, a, b):
+                    return a
+            """,
+        )
+    )
+    assert g.functions["protocol/a.py::C.m"].param_names() == ["a", "b"]
+
+
+# ---- wire-taint: the PR 4 forgery shape -------------------------------------
+
+FORGERY_BAD = """
+    from p2pdl_tpu.protocol.transport import control_from_wire
+
+    class Broadcaster:
+        def __init__(self):
+            self.readies = {}
+        def handle_frame(self, data):
+            batch = control_from_wire(data)
+            for sender, digest in batch.items:
+                self.readies.setdefault(digest, set()).add(sender)
+"""
+
+FORGERY_GOOD = """
+    from p2pdl_tpu.protocol.transport import control_from_wire
+
+    class Broadcaster:
+        def __init__(self):
+            self.readies = {}
+        def handle_frame(self, data):
+            batch = control_from_wire(data)
+            if not batch_ok(self.key_server, batch):
+                return
+            for sender, digest in batch.items:
+                self.readies.setdefault(digest, set()).add(sender)
+"""
+
+
+def test_wiretaint_flags_unverified_batch_write_into_protocol_state():
+    findings = lint(FORGERY_BAD)
+    assert rules_of(findings) == {"wire-taint"}
+    assert "protocol state `self.readies`" in findings[0].message
+
+
+def test_wiretaint_signature_check_sanitizes_the_batch():
+    assert lint(FORGERY_GOOD) == []
+
+
+def test_wiretaint_tracks_taint_through_a_helper_method():
+    findings = lint(
+        """
+        from p2pdl_tpu.protocol.transport import control_from_wire
+
+        class Broadcaster:
+            def __init__(self):
+                self.readies = {}
+            def _parse(self, data):
+                return control_from_wire(data)
+            def handle_frame(self, data):
+                batch = self._parse(data)
+                self.readies[batch.digest] = batch.sender
+        """
+    )
+    assert rules_of(findings) == {"wire-taint"}
+
+
+def test_wiretaint_tracks_taint_into_a_callee_parameter():
+    findings = lint(
+        """
+        from p2pdl_tpu.protocol.transport import recv_frame
+
+        class Hub:
+            def __init__(self):
+                self.inbox = []
+            def pump(self, sock):
+                frame = recv_frame(sock)
+                self._deliver(frame)
+            def _deliver(self, frame):
+                self.inbox.append(frame)
+        """
+    )
+    assert rules_of(findings) == {"wire-taint"}
+
+
+def test_wiretaint_handle_preverified_is_a_trust_boundary():
+    assert (
+        lint(
+            """
+            class Broadcaster:
+                def __init__(self):
+                    self.readies = {}
+                def handle_preverified(self, msg):
+                    self.readies[msg.digest] = msg.sender
+            """
+        )
+        == []
+    )
+
+
+# ---- wire-taint: the amplification shape ------------------------------------
+
+AMPLIFICATION_BAD = """
+    import struct
+    from p2pdl_tpu.protocol.transport import _recv_exact
+
+    def read_frame(sock):
+        header = _recv_exact(sock, 4)
+        (length,) = struct.unpack(">I", header)
+        return _recv_exact(sock, length)
+"""
+
+AMPLIFICATION_GOOD = """
+    import struct
+    from p2pdl_tpu.protocol.transport import _recv_exact
+
+    MAX_FRAME = 1 << 20
+
+    def read_frame(sock):
+        header = _recv_exact(sock, 4)
+        (length,) = struct.unpack(">I", header)
+        if length > MAX_FRAME:
+            return None
+        return _recv_exact(sock, length)
+"""
+
+
+def test_wiretaint_flags_read_sized_by_unverified_length():
+    findings = lint(AMPLIFICATION_BAD)
+    assert rules_of(findings) == {"wire-taint"}
+    assert "sized by an unverified wire integer" in findings[0].message
+
+
+def test_wiretaint_constant_bound_check_sanitizes_the_length():
+    assert lint(AMPLIFICATION_GOOD) == []
+
+
+def test_wiretaint_flags_allocation_sized_by_wire_int():
+    findings = lint(
+        """
+        from p2pdl_tpu.protocol.transport import recv_frame
+
+        def ingest(sock):
+            frame = recv_frame(sock)
+            n = frame[0]
+            return bytearray(n)
+        """
+    )
+    assert rules_of(findings) == {"wire-taint"}
+    assert "amplification" in findings[0].message
+
+
+def test_wiretaint_flags_unpack_with_tainted_slice_bounds():
+    findings = lint(
+        """
+        import struct
+        from p2pdl_tpu.protocol.transport import recv_frame
+
+        def parse(sock):
+            frame = recv_frame(sock)
+            (off,) = struct.unpack(">I", frame[:4])
+            return struct.unpack(">Q", frame[off : off + 8])
+        """
+    )
+    assert rules_of(findings) == {"wire-taint"}
+
+
+def test_wiretaint_flags_json_loads_of_unverified_body():
+    findings = lint(
+        """
+        import json
+
+        class Handler:
+            def handle(self):
+                body = self.rfile.read(64)
+                return json.loads(body)
+        """,
+        "runtime/fake_server.py",
+    )
+    assert rules_of(findings) == {"wire-taint"}
+    assert "json.loads" in findings[0].message
+
+
+def test_wiretaint_out_of_scope_tree_is_clean():
+    assert lint(AMPLIFICATION_BAD, "utils/fake.py") == []
+
+
+def test_wiretaint_suppression_directive_honored():
+    findings = lint(
+        """
+        from p2pdl_tpu.protocol.transport import recv_frame
+
+        def ingest(sock):
+            frame = recv_frame(sock)
+            n = frame[0]
+            return bytearray(n)  # p2plint: disable=wire-taint -- test sanctioned
+        """
+    )
+    assert findings == []
+
+
+def test_wiretaint_crosses_module_boundaries():
+    findings = lint_mods(
+        (
+            "protocol/transport.py",
+            """
+            def recv_frame(sock):
+                return sock.read()
+            """,
+        ),
+        (
+            "runtime/pump.py",
+            """
+            from p2pdl_tpu.protocol.transport import recv_frame
+
+            class Pump:
+                def __init__(self):
+                    self.frames = []
+                def pull(self, sock):
+                    self.frames.append(recv_frame(sock))
+            """,
+        ),
+    )
+    assert rules_of(findings) == {"wire-taint"}
+    assert findings[0].path == "runtime/pump.py"
+
+
+# ---- lock-discipline: cross-call attribution --------------------------------
+
+LOCKED_HELPER = """
+    import threading
+
+    class Hub:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = []
+        def put(self, x):
+            with self._lock:
+                self._q.append(x)
+                self._flush()
+        def _flush(self):
+            self._q.clear()
+"""
+
+
+def test_lock_discipline_exonerates_helper_only_called_under_lock():
+    assert lint(LOCKED_HELPER, "runtime/fake.py") == []
+
+
+def test_lock_discipline_flags_helper_also_reachable_unlocked():
+    # Same hub, but one extra unlocked entry point into _flush breaks the
+    # every-path-locked proof.
+    findings = lint(
+        """
+        import threading
+
+        class Hub:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []
+            def put(self, x):
+                with self._lock:
+                    self._q.append(x)
+                    self._flush()
+            def _flush(self):
+                self._q.clear()
+            def purge(self):
+                self._flush()
+        """,
+        "runtime/fake.py",
+    )
+    assert "lock-discipline" in rules_of(findings)
+
+
+def test_lock_discipline_entry_points_are_never_exonerated():
+    findings = lint(
+        """
+        import threading
+
+        class Hub:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []
+            def put(self, x):
+                with self._lock:
+                    self._q.append(x)
+            def drop(self):
+                self._q.clear()
+        """,
+        "runtime/fake.py",
+    )
+    assert rules_of(findings) == {"lock-discipline"}
+
+
+# ---- lock-membership --------------------------------------------------------
+
+
+def test_membership_mutation_without_lock_is_flagged():
+    findings = lint(
+        """
+        import threading
+
+        class Cluster:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._peers = set()
+            def join(self, pid):
+                self._peers.add(pid)
+        """,
+        "runtime/fake.py",
+    )
+    assert rules_of(findings) == {"lock-membership"}
+    assert "membership state `self._peers`" in findings[0].message
+
+
+def test_membership_mutation_under_lock_is_clean():
+    assert (
+        lint(
+            """
+            import threading
+
+            class Cluster:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._peers = set()
+                def join(self, pid):
+                    with self._lock:
+                        self._peers.add(pid)
+            """,
+            "runtime/fake.py",
+        )
+        == []
+    )
+
+
+def test_membership_helper_called_under_lock_is_clean():
+    assert (
+        lint(
+            """
+            import threading
+
+            class Cluster:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._peers = set()
+                def join(self, pid):
+                    with self._lock:
+                        self._admit(pid)
+                def _admit(self, pid):
+                    self._peers.add(pid)
+            """,
+            "runtime/fake.py",
+        )
+        == []
+    )
+
+
+def test_cross_object_membership_mutation_is_flagged():
+    """The Cluster._stopped race this PR fixed: a Node writing the cluster's
+    membership set directly instead of through a locked Cluster method."""
+    findings = lint(
+        """
+        import threading
+
+        class Cluster:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._peers = set()
+            def join(self, pid):
+                with self._lock:
+                    self._peers.add(pid)
+
+        class Node:
+            def __init__(self, cluster):
+                self.cluster = cluster
+            def leave(self, pid):
+                self.cluster._peers.discard(pid)
+        """,
+        "runtime/fake.py",
+    )
+    assert rules_of(findings) == {"lock-membership"}
+    assert "outside the owning class" in findings[0].message
+
+
+# ---- lock-order -------------------------------------------------------------
+
+CYCLE_DIRECT = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._lock_a = threading.Lock()
+            self._lock_b = threading.Lock()
+        def m1(self):
+            with self._lock_a:
+                with self._lock_b:
+                    pass
+        def m2(self):
+            with self._lock_b:
+                with self._lock_a:
+                    pass
+"""
+
+CYCLE_VIA_CALL = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._lock_a = threading.Lock()
+            self._lock_b = threading.Lock()
+        def m1(self):
+            with self._lock_a:
+                self._take_b()
+        def _take_b(self):
+            with self._lock_b:
+                pass
+        def m2(self):
+            with self._lock_b:
+                self._take_a()
+        def _take_a(self):
+            with self._lock_a:
+                pass
+"""
+
+ORDER_CONSISTENT = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._lock_a = threading.Lock()
+            self._lock_b = threading.Lock()
+        def m1(self):
+            with self._lock_a:
+                with self._lock_b:
+                    pass
+        def m2(self):
+            with self._lock_a:
+                with self._lock_b:
+                    pass
+"""
+
+
+def test_lock_order_flags_direct_two_lock_cycle():
+    findings = lint(CYCLE_DIRECT, "runtime/fake.py")
+    assert rules_of(findings) == {"lock-order"}
+    assert "lock-order cycle" in findings[0].message
+    assert "Pair._lock_a" in findings[0].message
+
+
+def test_lock_order_flags_cycle_through_a_call_edge():
+    findings = lint(CYCLE_VIA_CALL, "runtime/fake.py")
+    assert rules_of(findings) == {"lock-order"}
+
+
+def test_lock_order_consistent_ordering_is_clean():
+    assert lint(ORDER_CONSISTENT, "runtime/fake.py") == []
+
+
+def test_lock_order_flags_self_deadlock_via_helper():
+    findings = lint(
+        """
+        import threading
+
+        class Hub:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []
+            def put(self, x):
+                with self._lock:
+                    self._locked_len()
+            def _locked_len(self):
+                with self._lock:
+                    return len(self._q)
+        """,
+        "runtime/fake.py",
+    )
+    assert rules_of(findings) == {"lock-order"}
+    assert "self-deadlock" in findings[0].message
+
+
+def test_lock_order_rlock_reacquisition_is_clean():
+    assert (
+        lint(
+            """
+            import threading
+
+            class Hub:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                    self._q = []
+                def put(self, x):
+                    with self._lock:
+                        self._locked_len()
+                def _locked_len(self):
+                    with self._lock:
+                        return len(self._q)
+            """,
+            "runtime/fake.py",
+        )
+        == []
+    )
