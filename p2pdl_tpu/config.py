@@ -191,6 +191,16 @@ class Config:
     # update stack (O(peers x model) per device — simple, fine at small
     # scale, kept as the equivalence oracle).
     robust_impl: str = "blockwise"
+    # Route the distance-based robust reducers (Krum family, Bulyan,
+    # centered-clip, geometric median) through the fused Pallas
+    # distance/Gram kernels (ops/pallas_aggregators.py) — one VMEM-resident
+    # kernel per leaf/chunk instead of XLA's separate center/dot/assemble
+    # HLOs. Safe to enable anywhere: callers fall back to the XLA path
+    # off-TPU and on JAX builds running the jax_compat shims
+    # (pallas_aggregators.use_fused() gates every call site), and both
+    # paths agree within the documented tolerance contract
+    # (aggregators.PATH_TOLERANCE_ATOL).
+    pallas_aggregators: bool = False
     # secure_fedavg mask graph: 0 = every trainer pair (Bonawitz et al. 2017;
     # O(T^2 x model) PRNG per round — fine to ~100 trainers), k > 0 = the
     # k-regular ring graph (Bell et al. 2020; O(T x k x model), scales to

@@ -22,6 +22,29 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from p2pdl_tpu.ops import pallas_aggregators
+
+# Tolerance contract between aggregation paths. Every implementation pair
+# of the same reducer — gathered XLA here, blockwise Gram-space
+# (``sharded_aggregators``), fused Pallas kernel
+# (``ops.pallas_aggregators``) — computes the same real-arithmetic
+# quantity in a different float32 summation order, and every path
+# accumulates in float32 and quantizes to the leaf dtype exactly ONCE at
+# the end (the sharded extraction included — see
+# ``sharded_aggregators._extract_weighted``). Paths therefore agree to
+# PATH_TOLERANCE_ATOL on O(1)-scale inputs; the bound is ABSOLUTE at O(1)
+# scale, so comparisons of quantities whose magnitude grows with the
+# problem (e.g. squared distances summed over D features) scale it by the
+# magnitude of the values compared. When updates share a large
+# common component (the correlated federated regime) the centered
+# distance paths still cancel it, but ~offset/spread relative bits are
+# lost in the uncentered terms, so cross-path comparisons there use
+# PATH_TOLERANCE_ATOL_CORRELATED. tests/test_sharded_aggregators.py
+# asserts both; a change that needs looser bounds should widen the
+# contract here, not per-test.
+PATH_TOLERANCE_ATOL = 5e-5
+PATH_TOLERANCE_ATOL_CORRELATED = 1e-3
+
 
 def fedavg(deltas: Any, weights: jnp.ndarray | None = None) -> Any:
     """(Weighted) mean over the update axis — reference semantics
@@ -36,7 +59,7 @@ def fedavg(deltas: Any, weights: jnp.ndarray | None = None) -> Any:
     return jax.tree.map(leaf, deltas)
 
 
-def pairwise_sq_dists(deltas: Any) -> jnp.ndarray:
+def pairwise_sq_dists(deltas: Any, *, pallas: bool = False) -> jnp.ndarray:
     """``[T, T]`` squared L2 distances between full (concatenated) updates.
 
     Computed per leaf as ``|a|^2 + |b|^2 - 2 a.b`` with the cross term a
@@ -48,12 +71,29 @@ def pairwise_sq_dists(deltas: Any) -> jnp.ndarray:
     while the distances are O(spread^2), cancelling the information away
     (the blockwise path, ``sharded_aggregators.block_gram``, centers for
     the same reason).
+
+    ``pallas=True`` (``Config.pallas_aggregators``) routes each leaf term
+    through the fused Pallas kernel when trusted on this build/backend
+    (``pallas_aggregators.use_fused()``): center-subtract, Gram matmul, and
+    distance assembly in one VMEM-resident kernel, no per-leaf ``[T, T]``
+    HBM round-trips. The kernel clamps each leaf term to >= 0 before
+    summation where this path clamps once at the end — both are exact in
+    real arithmetic (every per-leaf term is a squared distance), so the
+    difference is float noise inside :data:`PATH_TOLERANCE_ATOL`.
     """
     leaves = jax.tree.leaves(deltas)
     t = leaves[0].shape[0]
+    use_kernel = (
+        pallas
+        and t <= pallas_aggregators.MAX_FUSED_T
+        and pallas_aggregators.use_fused()
+    )
     total = jnp.zeros((t, t), jnp.float32)
     for l in leaves:
         v = l.reshape(t, -1).astype(jnp.float32)
+        if use_kernel:
+            total = total + pallas_aggregators.fused_pairwise_sq_dists(v)
+            continue
         v = v - jnp.mean(v, axis=0, keepdims=True)
         sq = jnp.sum(v * v, axis=-1)
         gram = v @ v.T
@@ -61,10 +101,10 @@ def pairwise_sq_dists(deltas: Any) -> jnp.ndarray:
     return jnp.maximum(total, 0.0)
 
 
-def krum_scores(deltas: Any, f: int) -> jnp.ndarray:
+def krum_scores(deltas: Any, f: int, *, pallas: bool = False) -> jnp.ndarray:
     """Krum score per update: sum of its ``T - f - 2`` smallest distances to
     other updates (lower = more central)."""
-    d = pairwise_sq_dists(deltas)
+    d = pairwise_sq_dists(deltas, pallas=pallas)
     t = d.shape[0]
     if t < 2 * f + 3:
         # Below n >= 2f+3 the Krum guarantee is void: f colluding identical
@@ -77,19 +117,19 @@ def krum_scores(deltas: Any, f: int) -> jnp.ndarray:
     return jnp.sum(d_sorted[:, :k], axis=1)
 
 
-def krum(deltas: Any, f: int) -> Any:
+def krum(deltas: Any, f: int, *, pallas: bool = False) -> Any:
     """Select the single most-central update (Krum)."""
-    best = jnp.argmin(krum_scores(deltas, f))
+    best = jnp.argmin(krum_scores(deltas, f, pallas=pallas))
     return jax.tree.map(lambda l: l[best], deltas)
 
 
-def multi_krum(deltas: Any, f: int, m: int = 0) -> Any:
+def multi_krum(deltas: Any, f: int, m: int = 0, *, pallas: bool = False) -> Any:
     """Average of the ``m`` lowest-scored updates (multi-Krum).
 
     ``m == 0`` defaults to ``T - f - 2`` (the paper's choice), clamped to 1.
     Implemented as a 0/1-weighted mean so shapes stay static under jit.
     """
-    scores = krum_scores(deltas, f)
+    scores = krum_scores(deltas, f, pallas=pallas)
     t = scores.shape[0]
     if m <= 0:
         m = max(t - f - 2, 1)
@@ -177,7 +217,7 @@ def closest_to_median_mean(srt: jnp.ndarray, beta: int) -> jnp.ndarray:
     return jnp.take_along_axis(wsum, i[None], axis=0)[0] / beta
 
 
-def bulyan(deltas: Any, f: int) -> Any:
+def bulyan(deltas: Any, f: int, *, pallas: bool = False) -> Any:
     """Bulyan (El Mhamdi et al., ICML 2018): iterative-Krum-select
     ``theta = T - 2f`` updates, then aggregate them coordinate-wise by the
     ``theta - 2f`` values closest to the per-coordinate median of the
@@ -191,7 +231,7 @@ def bulyan(deltas: Any, f: int) -> Any:
         raise ValueError(f"bulyan requires T >= 4f+3 ({4 * f + 3}), got T={t}")
     theta = t - 2 * f
     beta = theta - 2 * f
-    sel = _bulyan_select(pairwise_sq_dists(deltas), f, theta)
+    sel = _bulyan_select(pairwise_sq_dists(deltas, pallas=pallas), f, theta)
 
     def leaf(l):
         flat = l.reshape(t, -1).astype(jnp.float32)
@@ -246,7 +286,41 @@ def _mean_init(leaves: list) -> list:
 CCLIP_ITERS = 10
 
 
-def centered_clip(deltas: Any, tau: float = 0.0, iters: int = 0) -> Any:
+def _centered_clip_gram(leaves: list, treedef, tau: float, iters: int) -> Any:
+    """Centered clipping with the whole iteration in GRAM SPACE, fed by the
+    fused Pallas kernel. The iterate is an affine combination of the inputs
+    with coefficients summing to 1 (see ``centered_clip_sharded``, the
+    blockwise twin of this path), so every distance it needs reduces to
+    entries of the centered Gram matrix — one fused kernel launch per leaf
+    builds ``G``, the iteration updates only the ``[T]`` coefficient
+    vector, and the result is one weighted sum applied ONCE in float32
+    (the same quantization discipline as :data:`PATH_TOLERANCE_ATOL`)."""
+    from p2pdl_tpu.ops.sharded_aggregators import _dists_from_gram
+
+    t = leaves[0].shape[0]
+    gram = jnp.zeros((t, t), jnp.float32)
+    for l in leaves:
+        gram = gram + pallas_aggregators.fused_centered_gram(l.reshape(t, -1))
+
+    def step(_, c):
+        d = _dists_from_gram(gram, c)
+        tau_eff = jnp.where(tau > 0, jnp.float32(tau), jnp.median(d))
+        s = jnp.minimum(1.0, tau_eff / jnp.maximum(d, 1e-12))
+        return (1.0 - jnp.mean(s)) * c + s / t
+
+    c = jax.lax.fori_loop(0, iters, step, jnp.full((t,), 1.0 / t, jnp.float32))
+    return jax.tree.unflatten(
+        treedef,
+        [
+            jnp.tensordot(c, l.astype(jnp.float32), axes=1).astype(l.dtype)
+            for l in leaves
+        ],
+    )
+
+
+def centered_clip(
+    deltas: Any, tau: float = 0.0, iters: int = 0, *, pallas: bool = False
+) -> Any:
     """Centered clipping (Karimireddy et al., ICML 2021): iterate
     ``v <- v + mean_i clip(x_i - v, tau)`` where ``clip`` rescales to radius
     ``tau``. The provable defense against *colluding* attacks that hide
@@ -277,6 +351,14 @@ def centered_clip(deltas: Any, tau: float = 0.0, iters: int = 0) -> Any:
     t = leaves[0].shape[0]
     if not iters:
         iters = CCLIP_ITERS
+    if (
+        pallas
+        and t <= pallas_aggregators.MAX_FUSED_T
+        and pallas_aggregators.use_fused()
+    ):
+        # Gram-space iteration fed by the fused kernel: O(T^2) per step on
+        # a [T] coefficient vector instead of O(T x D) full-vector sweeps.
+        return _centered_clip_gram(leaves, jax.tree.structure(deltas), tau, iters)
 
     def step(_, v_leaves):
         d = _full_vector_dists(leaves, v_leaves)  # [T]

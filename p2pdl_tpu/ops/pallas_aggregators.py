@@ -1,0 +1,271 @@
+"""Fused pairwise-distance / Gram-accumulate Pallas TPU kernel for the
+robust aggregators.
+
+The ``[T, T]`` pairwise squared-distance matrix behind Krum, Bulyan and the
+Gram-space iterative reducers is the dominant non-matmul cost at high peer
+counts: the XLA paths (``aggregators.pairwise_sq_dists``,
+``sharded_aggregators.block_gram``) materialize a centered copy of every
+``[T, block]`` update chunk in HBM, run a generic dot, and then assemble
+``sq[:, None] + sq[None, :] - 2*gram`` as separate HLOs — three HBM
+round-trips of ``[T, T]``-shaped traffic per leaf/block. This kernel fuses
+the whole identity: update chunks stream through VMEM feature block by
+feature block, the center-subtract happens in registers, the Gram
+accumulator lives in the (revisited) output block in VMEM across the
+sequential grid, and the distance assembly (including the diagonal
+extraction — after centering ``sq_i = G_ii``) runs on the final grid step
+before the single ``[T, T]`` result leaves the chip.
+
+Centering semantics match the XLA paths exactly: the mean over the center
+rows (``center_mask``; all rows by default) is subtracted from EVERY row —
+the float32 conditioning fix both reference paths rely on (entries at
+O(spread^2), not O(offset^2)). Zero feature padding is both center- and
+Gram-neutral, and padded T rows only contaminate padded Gram entries (a
+row's centered value never depends on other rows beyond the shared mean),
+so the unpadded ``[T, T]`` slice is exact.
+
+Routing follows ``ops.pallas_attention``: Mosaic-compiled on TPU, the XLA
+reference path elsewhere (the generic Pallas interpreter breaks under
+``shard_map`` vma typing in current JAX, and the reducers run inside
+``shard_map``). On JAX builds old enough to need the ``jax_compat`` shims
+the kernels are not trusted at all (``available()`` is False) and every
+caller falls back to the XLA path — same capability-detection stance as
+``sharded_aggregators.bulyan_sharded``. Kernel *math* is CPU-tested by
+passing ``interpret=True`` explicitly on plain arrays
+(tests/test_sharded_aggregators.py compares it against the dense Gram
+oracle across dtypes, peer counts, and center-mask clamps).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:  # capability probe, not a hard dependency (old builds lack pieces)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_IMPORTED = True
+except Exception:  # pragma: no cover - import-time environment probe
+    pl = None
+    pltpu = None
+    _PALLAS_IMPORTED = False
+
+# Old-build spellings resolved lazily (NOT via jax_compat.install(), which
+# is opt-in and process-wide): TPUCompilerParams was renamed
+# CompilerParams, and pre-vma ShapeDtypeStruct rejects the vma kwarg.
+# Interpret mode works on those builds with these two bridges, which is
+# what keeps the CPU equivalence tests running there instead of
+# collection-erroring like the modern-API-only flash kernels.
+_COMPILER_PARAMS = (
+    getattr(pltpu, "CompilerParams", None) or getattr(pltpu, "TPUCompilerParams", None)
+    if _PALLAS_IMPORTED
+    else None
+)
+
+
+def _sds(shape, dtype, vma):
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # pre-vma build: no replication typing to satisfy
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+# The Gram accumulator is the [T_pad, T_pad] float32 output block held in
+# VMEM across the sequential feature grid: 1024^2 f32 = 4 MB, comfortable
+# beside two streamed operand blocks in ~16 MB of VMEM. Past this the
+# accumulator alone would crowd out the pipeline — callers fall back to
+# the blockwise XLA path, which has no such cap.
+MAX_FUSED_T = 1024
+
+# Feature-block width streamed through VMEM per grid step. Lane-aligned
+# (multiple of 128); 512 keeps the [T_pad, block_d] operand block at
+# 2 MB even at the T cap.
+_DEFAULT_BLOCK_D = 512
+
+_SUBLANES = 8  # float32 sublane tile: pad T to a multiple of this
+
+# Test hook: when True, use_fused() reports True off-TPU and every kernel
+# launch runs in the Pallas interpreter, so CPU tier-1 can exercise the
+# flag-gated REDUCER paths (krum(pallas=True), the Gram-space
+# centered-clip), not just the raw kernels. Only valid OUTSIDE shard_map
+# (the generic interpreter breaks under vma typing there) — tests
+# monkeypatch it around gathered-path calls.
+_FORCE_INTERPRET = False
+
+
+def available() -> bool:
+    """Kernel path trusted on this JAX build: pallas imports and the
+    process is NOT running on the ``jax_compat`` shims (the shimmed builds
+    predate the vma/CompilerParams machinery the kernels are written
+    against — same gate as ``bulyan_sharded``)."""
+    from p2pdl_tpu.utils import jax_compat
+
+    return _PALLAS_IMPORTED and not jax_compat.active()
+
+
+def use_fused() -> bool:
+    """True when flag-gated callers should take the kernel path: build
+    capability plus an actual TPU device (off-TPU the XLA path IS the
+    fallback — see module docstring for why interpret mode cannot serve
+    inside ``shard_map``)."""
+    return available() and (_on_tpu() or _FORCE_INTERPRET)
+
+
+def _on_tpu() -> bool:
+    """Device-keyed TPU detection (same rationale as
+    ``pallas_attention._on_tpu``: TPU PJRT plugins can register under a
+    different platform name, e.g. this image's tunnel's "axon")."""
+    dev = jax.devices()[0]
+    return "tpu" in dev.platform.lower() or "tpu" in dev.device_kind.lower()
+
+
+def _vma(x) -> frozenset:
+    """Varying-manual-axes of ``x`` — pallas_call output avals must carry
+    the operands' vma when the kernel runs inside ``shard_map``."""
+    try:
+        return frozenset(jax.typeof(x).vma)
+    except Exception:  # non-traced input or backend without vma support
+        return frozenset()
+
+
+def _gram_kernel(x_ref, cmask_ref, out_ref, *, center, assemble, t_pad):
+    """Grid ``(n_feature_blocks,)``, sequential. Refs: x ``[t_pad,
+    block_d]`` f32; cmask ``[1, t_pad]`` f32 (1.0 on center rows); out
+    ``[t_pad, t_pad]`` f32 — the Gram accumulator itself (the block is
+    revisited every step, so it persists in VMEM like scratch but needs no
+    separate copy-out).
+
+    Per step: fused center-subtract (one ``[1, t_pad] @ [t_pad, block_d]``
+    MXU row for the mean) + Gram accumulate. Final step optionally
+    rewrites the accumulated Gram into clamped squared distances in place
+    (``assemble``) — the diagonal comes off an iota mask, no host trip."""
+    j = pl.program_id(0)
+    nj = pl.num_programs(0)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    xb = x_ref[...]  # [t_pad, block_d] float32
+    if center:
+        cmask = cmask_ref[...]  # [1, t_pad]
+        n_center = jnp.maximum(jnp.sum(cmask), 1.0)
+        mean = (
+            jax.lax.dot_general(
+                cmask, xb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            / n_center
+        )  # [1, block_d]
+        xb = xb - mean
+    out_ref[...] += jax.lax.dot_general(
+        xb, xb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    if assemble:
+
+        @pl.when(j == nj - 1)
+        def _():
+            g = out_ref[...]
+            eq = jax.lax.broadcasted_iota(
+                jnp.int32, (t_pad, t_pad), 0
+            ) == jax.lax.broadcasted_iota(jnp.int32, (t_pad, t_pad), 1)
+            diag = jnp.sum(jnp.where(eq, g, 0.0), axis=1)  # [t_pad]
+            d2 = diag[:, None] + diag[None, :] - 2.0 * g
+            out_ref[...] = jnp.maximum(d2, 0.0)
+
+
+def _fused_call(x, center_mask, *, center, assemble, block_d, interpret):
+    """Shared pallas_call wrapper: pad, launch, slice. ``x``: [T, D]
+    (cast to float32); returns [T, T] float32."""
+    t, d = x.shape
+    if t > MAX_FUSED_T:
+        raise ValueError(
+            f"fused aggregator kernel caps T at {MAX_FUSED_T} (the [T, T] "
+            f"VMEM accumulator), got T={t}; use the blockwise XLA path"
+        )
+    x = x.astype(jnp.float32)
+    block_d = int(block_d or _DEFAULT_BLOCK_D)
+    t_pad = -(-t // _SUBLANES) * _SUBLANES
+    block_d = min(block_d, -(-d // 128) * 128)
+    d_pad = -(-d // block_d) * block_d
+    xp = jnp.pad(x, ((0, t_pad - t), (0, d_pad - d)))
+    if center_mask is None:
+        cm = jnp.ones((1, t), jnp.float32)
+    else:
+        cm = center_mask.astype(jnp.float32).reshape(1, t)
+    # Zero-extend the mask over padded rows so they never enter the mean.
+    cm = jnp.pad(cm, ((0, 0), (0, t_pad - t)))
+    # Mask must share x's vma inside shard_map (a replicated mask against
+    # a varying operand is a pallas typing error there).
+    cm = cm + jnp.zeros_like(xp[:1, :1])
+
+    kernel = functools.partial(
+        _gram_kernel, center=center, assemble=assemble, t_pad=t_pad
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(d_pad // block_d,),
+        in_specs=[
+            pl.BlockSpec((t_pad, block_d), lambda j: (0, j)),
+            pl.BlockSpec((1, t_pad), lambda j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((t_pad, t_pad), lambda j: (0, 0)),
+        out_shape=_sds((t_pad, t_pad), jnp.float32, _vma(x)),
+        compiler_params=_COMPILER_PARAMS(dimension_semantics=("arbitrary",)),
+        interpret=bool(interpret or _FORCE_INTERPRET),
+    )(xp, cm)
+    return out[:t, :t]
+
+
+def fused_centered_gram(
+    x: jnp.ndarray,
+    center_mask: jnp.ndarray | None = None,
+    *,
+    block_d: int | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """``[T, T]`` float32 Gram matrix of the (mean-centered) rows of ``x``
+    ``[T, D]`` in one fused kernel. ``center_mask`` (``[T]``, nonzero =
+    center row) selects the rows whose mean is subtracted from every row;
+    ``None`` centers on all rows. Drop-in for ``block_gram``'s per-chunk
+    center+accumulate (the blockwise path calls this per gathered chunk).
+
+    Callers gate on :func:`use_fused`; ``interpret=True`` runs the same
+    kernel in the Pallas interpreter for CPU equivalence tests."""
+    return _fused_call(
+        x, center_mask, center=True, assemble=False,
+        block_d=block_d, interpret=interpret,
+    )
+
+
+def fused_gram(
+    x: jnp.ndarray, *, block_d: int | None = None, interpret: bool = False
+) -> jnp.ndarray:
+    """Uncentered ``[T, T]`` Gram matrix (``block_gram`` with
+    ``center_idx=None`` semantics) in one fused kernel."""
+    return _fused_call(
+        x, None, center=False, assemble=False, block_d=block_d,
+        interpret=interpret,
+    )
+
+
+def fused_pairwise_sq_dists(
+    x: jnp.ndarray,
+    center_mask: jnp.ndarray | None = None,
+    *,
+    block_d: int | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """``[T, T]`` clamped squared L2 distances between the rows of ``x``
+    ``[T, D]``, fully fused: center-subtract, Gram accumulate over feature
+    blocks, and the ``sq[:, None] + sq[None, :] - 2*gram`` assembly all
+    happen in VMEM — the distance matrix is the only ``[T, T]`` array that
+    ever touches HBM. Matches ``aggregators.pairwise_sq_dists``'s per-leaf
+    term at :data:`~p2pdl_tpu.ops.aggregators.PATH_TOLERANCE_ATOL` (float
+    summation order differs; see the tolerance contract there)."""
+    return _fused_call(
+        x, center_mask, center=True, assemble=True,
+        block_d=block_d, interpret=interpret,
+    )

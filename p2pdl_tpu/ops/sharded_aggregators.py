@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from p2pdl_tpu.ops import pallas_aggregators
 from p2pdl_tpu.parallel.mesh import PEER_AXIS
 
 # Target transient size for one gathered block: P * block * 4 bytes. 2^22
@@ -80,6 +81,7 @@ def block_gram(
     axis_name: str = PEER_AXIS,
     block: int | None = None,
     center_idx: jnp.ndarray | None = None,
+    pallas: bool = False,
 ) -> jnp.ndarray:
     """``[P, P]`` Gram matrix of full flattened updates, streamed blockwise.
 
@@ -95,14 +97,34 @@ def block_gram(
     cancellation that turns Krum scores and Weiszfeld weights into noise.
     Centering on the trainer mean makes entries O(spread^2) and restores
     conditioning; callers doing distance math should always pass it.
+
+    ``pallas=True`` (``Config.pallas_aggregators``) routes each gathered
+    chunk's center+accumulate through the fused Pallas kernel when trusted
+    on this build/backend (``pallas_aggregators.use_fused()``): the
+    centered copy of the ``[P, B]`` chunk never materializes in HBM.
+    Per-chunk centering equals whole-matrix centering (column means are
+    per-column), so the accumulated Gram matches this path within
+    :data:`~p2pdl_tpu.ops.aggregators.PATH_TOLERANCE_ATOL`.
     """
     flat = _flatten_local(delta)
     num_peers = flat.shape[0] * lax.axis_size(axis_name)
     if block is None:
         block = default_block(num_peers, flat.shape[1])
+    use_kernel = (
+        pallas
+        and num_peers <= pallas_aggregators.MAX_FUSED_T
+        and pallas_aggregators.use_fused()
+    )
+    center_mask = None
+    if use_kernel and center_idx is not None:
+        center_mask = jnp.zeros((num_peers,), jnp.float32).at[center_idx].set(1.0)
 
     def step(gram, chunk):
         g = lax.all_gather(chunk, axis_name, axis=0, tiled=True)  # [P, B]
+        if use_kernel:
+            if center_idx is None:
+                return gram + pallas_aggregators.fused_gram(g), None
+            return gram + pallas_aggregators.fused_centered_gram(g, center_mask), None
         if center_idx is not None:
             g = g - jnp.mean(g[center_idx], axis=0, keepdims=True)
         return gram + g @ g.T, None
@@ -143,15 +165,28 @@ def _extract_weighted(
     delta: Any, peer_weights: jnp.ndarray, axis_name: str
 ) -> Any:
     """Weighted sum over ALL peers via masked ``psum`` — the collective that
-    replaces materializing any stacked copy. ``peer_weights``: ``[P]``."""
+    replaces materializing any stacked copy. ``peer_weights``: ``[P]``.
+
+    Accumulates in FLOAT32 and quantizes to the leaf dtype exactly once at
+    the end — the same discipline as the gathered reducers' final
+    ``.astype`` (see ``aggregators.PATH_TOLERANCE_ATOL``). Weighting in the
+    leaf dtype instead (the old behavior) rounds every product AND every
+    psum partial to e.g. bfloat16, which diverges from the gathered paths
+    by the leaf ulp at the update's magnitude — catastrophic under the
+    correlated-deltas regime where a large common offset inflates that ulp
+    past the honest spread (regression-tested in
+    tests/test_sharded_aggregators.py)."""
     leaves = jax.tree.leaves(delta)
     l_per_dev = leaves[0].shape[0]
     dev = lax.axis_index(axis_name)
-    local_w = peer_weights[dev * l_per_dev + jnp.arange(l_per_dev)]
+    local_w = peer_weights[dev * l_per_dev + jnp.arange(l_per_dev)].astype(
+        jnp.float32
+    )
 
     def leaf(d):
-        w = local_w.astype(d.dtype).reshape((l_per_dev,) + (1,) * (d.ndim - 1))
-        return lax.psum(jnp.sum(d * w, axis=0), axis_name)
+        w = local_w.reshape((l_per_dev,) + (1,) * (d.ndim - 1))
+        acc = lax.psum(jnp.sum(d.astype(jnp.float32) * w, axis=0), axis_name)
+        return acc.astype(d.dtype)
 
     return jax.tree.map(leaf, delta)
 
@@ -162,10 +197,11 @@ def krum_sharded(
     f: int,
     axis_name: str = PEER_AXIS,
     block: int | None = None,
+    pallas: bool = False,
 ) -> Any:
     """Krum's single most-central trainer update, O(P × block) transient."""
     num_peers = jax.tree.leaves(delta)[0].shape[0] * lax.axis_size(axis_name)
-    gram = block_gram(delta, axis_name, block, center_idx=trainer_idx)
+    gram = block_gram(delta, axis_name, block, center_idx=trainer_idx, pallas=pallas)
     scores = _scores_from_gram(gram, trainer_idx, f)
     winner = trainer_idx[jnp.argmin(scores)]
     weights = (jnp.arange(num_peers) == winner).astype(jnp.float32)
@@ -179,6 +215,7 @@ def multi_krum_sharded(
     m: int = 0,
     axis_name: str = PEER_AXIS,
     block: int | None = None,
+    pallas: bool = False,
 ) -> Any:
     """Mean of the m lowest-scored trainer updates (``aggregators.multi_krum``
     semantics), extracted by one weighted masked ``psum``."""
@@ -187,7 +224,7 @@ def multi_krum_sharded(
     if m <= 0:
         m = max(t - f - 2, 1)
     m = min(m, t)
-    gram = block_gram(delta, axis_name, block, center_idx=trainer_idx)
+    gram = block_gram(delta, axis_name, block, center_idx=trainer_idx, pallas=pallas)
     scores = _scores_from_gram(gram, trainer_idx, f)
     chosen = trainer_idx[jnp.argsort(scores)[:m]]
     weights = jnp.isin(jnp.arange(num_peers), chosen).astype(jnp.float32) / m
@@ -266,6 +303,7 @@ def bulyan_sharded(
     f: int,
     axis_name: str = PEER_AXIS,
     block: int | None = None,
+    pallas: bool = False,
 ) -> Any:
     """Bulyan with O(P × block) transient: the iterative Krum selection
     runs on the centered-Gram distance matrix (``[T, T]`` host of the same
@@ -292,7 +330,7 @@ def bulyan_sharded(
         raise ValueError(f"bulyan requires T >= 4f+3 ({4 * f + 3}), got T={t}")
     theta = t - 2 * f
     beta = theta - 2 * f
-    gram = block_gram(delta, axis_name, block, center_idx=trainer_idx)
+    gram = block_gram(delta, axis_name, block, center_idx=trainer_idx, pallas=pallas)
     sel = _bulyan_select(_d2_from_gram(gram, trainer_idx), f, theta)  # [T] 0/1
 
     def reduce_fn(g):  # [T, B] this feature block's trainer values
@@ -320,6 +358,7 @@ def centered_clip_sharded(
     iters: int | None = None,
     axis_name: str = PEER_AXIS,
     block: int | None = None,
+    pallas: bool = False,
 ) -> Any:
     """Centered clipping with O(P × block) transient — the whole iteration
     runs in GRAM SPACE, like :func:`geometric_median_sharded`.
@@ -339,7 +378,7 @@ def centered_clip_sharded(
     if not iters:  # None or the 0 sentinel (Config.cclip_iters default)
         iters = CCLIP_ITERS
     num_peers = jax.tree.leaves(delta)[0].shape[0] * lax.axis_size(axis_name)
-    gram = block_gram(delta, axis_name, block, center_idx=trainer_idx)
+    gram = block_gram(delta, axis_name, block, center_idx=trainer_idx, pallas=pallas)
     sub = gram[trainer_idx][:, trainer_idx].astype(jnp.float32)  # [T, T]
     t = sub.shape[0]
     c0 = jnp.full((t,), 1.0 / t, jnp.float32)
@@ -365,6 +404,7 @@ def geometric_median_sharded(
     iters: int | None = None,
     axis_name: str = PEER_AXIS,
     block: int | None = None,
+    pallas: bool = False,
 ) -> Any:
     """Geometric median (RFA / smoothed Weiszfeld) with O(P × block)
     transient — the whole iteration runs in GRAM SPACE.
@@ -388,7 +428,7 @@ def geometric_median_sharded(
     # avoiding the float32 cancellation that would otherwise flatten the
     # weights toward uniform whenever updates share a large common
     # component (the realistic correlated-deltas regime).
-    gram = block_gram(delta, axis_name, block, center_idx=trainer_idx)
+    gram = block_gram(delta, axis_name, block, center_idx=trainer_idx, pallas=pallas)
     sub = gram[trainer_idx][:, trainer_idx].astype(jnp.float32)  # [T, T]
     t = sub.shape[0]
 

@@ -1,0 +1,239 @@
+"""Gauge-driven overlap autotuner: deterministic hill-climb over the
+driver's overlap knobs.
+
+The overlap levers landed as hand-picked constants — ``pipeline_depth``
+(depth-k deferred readbacks) and ``rounds_per_call`` (fused scan-block
+length) — while the performance plane already measures their effect every
+round: ``driver.rounds_per_sec``, ``driver.overlap_efficiency``,
+``driver.inflight_rounds``, ``driver.mfu``, and the recompile sentinel.
+This module closes the loop: a small controller that reads ONLY recorded
+per-round observations (round durations from the RoundRecord stream;
+gauge readings ride along for attribution) and walks one knob along a
+fixed ladder of candidate values, turning the constants into measured
+optima per model/backend.
+
+Determinism contract (policed by p2plint's replay-scope rules — this
+file lives in ``parallel/``): the controller is a pure function of its
+observation sequence. No wall clock, no entropy, no set iteration — two
+runs fed identical observation streams produce identical knob
+trajectories (test-pinned in ``tests/test_autotune.py``). Wall-clock
+VALUES do flow in as observations (that is the point: the knob converges
+to the measured optimum), but the DECISION RULE stays replayable.
+
+Recompile accounting stays attributable: every distinct
+``rounds_per_call`` the tuner visits adds at most one compiled scan-block
+shape, so the driver recomputes the sentinel's expected-compile budget
+from ``fused_block_sizes()`` over the sizes already seen plus the
+remaining schedule — retuning must never surface as a recompile anomaly
+(test-pinned: sentinel quiet across retune events). The ladder being
+finite is what makes that budget finite.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+# Candidate rungs per knob. Power-of-two spacing: each rung is at most one
+# new compiled program shape (rounds_per_call) or one window size
+# (pipeline_depth), and the throughput response is near-monotone in log
+# space — exactly what a +-1-rung hill climb handles. The configured
+# start value is spliced in if it is not already a rung.
+_LADDERS: dict[str, tuple[int, ...]] = {
+    "pipeline_depth": (1, 2, 4, 8),
+    "rounds_per_call": (1, 2, 4, 8, 16, 32),
+}
+
+
+class HillClimb:
+    """±1-rung hill climb on a fixed value ladder (higher score = better).
+
+    Feed scores via :meth:`observe`; every ``window`` observations one
+    :meth:`step` consumes them: the window mean becomes the current rung's
+    score and the controller either records its incumbent's baseline,
+    accepts a probe (beats the incumbent by ``rel_margin`` relative — the
+    deadband that keeps run-to-run timing noise from flapping the knob),
+    or rejects it and returns to the incumbent. A rejected direction is
+    abandoned; when both directions (or the ladder edges) are exhausted
+    the climb SETTLES and holds the incumbent for the rest of the run.
+    Exploration is therefore bounded by the rungs actually visited, never
+    the run length.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        ladder: tuple[int, ...],
+        start: int,
+        window: int = 4,
+        rel_margin: float = 0.02,
+    ) -> None:
+        self.name = str(name)
+        self.ladder = tuple(sorted(set(list(ladder) + [int(start)])))
+        self.window = max(1, int(window))
+        self.rel_margin = float(rel_margin)
+        self.idx = self.ladder.index(int(start))
+        self.best_idx = self.idx
+        self.best_score: Optional[float] = None
+        self.settled = False
+        self.retunes = 0
+        self._scores: list[float] = []
+        self._dir = 1
+        self._tried_up = False
+        self._tried_down = False
+        self.trajectory: list[int] = [self.current]
+        self.events: list[dict[str, Any]] = []
+
+    @property
+    def current(self) -> int:
+        return self.ladder[self.idx]
+
+    def observe(self, score: float) -> None:
+        s = float(score)
+        if not self.settled and math.isfinite(s):
+            self._scores.append(s)
+
+    def ready(self) -> bool:
+        return (not self.settled) and len(self._scores) >= self.window
+
+    def _exhausted(self, d: int) -> bool:
+        if d > 0:
+            return self._tried_up or self.best_idx == len(self.ladder) - 1
+        return self._tried_down or self.best_idx == 0
+
+    def _next_probe(self) -> None:
+        """From the incumbent, move onto the next unexplored neighbor rung
+        — or settle when there is none."""
+        for d in (self._dir, -self._dir):
+            if not self._exhausted(d):
+                self._dir = d
+                self.idx = self.best_idx + d
+                return
+        self.idx = self.best_idx
+        self.settled = True
+        self.events.append({"event": "settled", "value": self.current})
+
+    def step(self) -> int:
+        """Consume a full observation window and advance one climb step;
+        returns the knob value to use next (unchanged while the window is
+        still filling or after settling)."""
+        if not self.ready():
+            return self.current
+        s = sum(self._scores) / len(self._scores)
+        self._scores = []
+        self.retunes += 1
+        if self.best_score is None or self.idx == self.best_idx:
+            # Measure the incumbent, then go probe a neighbor.
+            self.best_score = s
+            self.events.append(
+                {"event": "baseline", "value": self.current, "score": s}
+            )
+            self._next_probe()
+        elif s > self.best_score * (1.0 + self.rel_margin):
+            # Probe wins: it becomes the incumbent. Keep climbing the same
+            # way; the rung behind is the old incumbent, already measured
+            # worse, so that direction stays closed.
+            self.events.append(
+                {"event": "accept", "value": self.current, "score": s}
+            )
+            self.best_idx = self.idx
+            self.best_score = s
+            if self._dir > 0:
+                self._tried_down = True
+            else:
+                self._tried_up = True
+            self._next_probe()
+        else:
+            self.events.append(
+                {"event": "reject", "value": self.current, "score": s}
+            )
+            if self._dir > 0:
+                self._tried_up = True
+            else:
+                self._tried_down = True
+            self._dir = -self._dir
+            self._next_probe()
+        self.trajectory.append(self.current)
+        return self.current
+
+
+class OverlapAutotuner:
+    """Driver-facing wrapper: one :class:`HillClimb` on one overlap knob,
+    scored by measured round throughput (``1 / duration_s``).
+
+    Gauge readings (``overlap_efficiency``, ``inflight_rounds``, ``mfu``)
+    are recorded for the perf summary — attribution, not decision inputs,
+    so the decision rule remains a pure function of the duration stream
+    and the trajectory is reproducible from the RoundRecord stream alone.
+    """
+
+    def __init__(
+        self,
+        knob: str,
+        start: int,
+        window: int = 4,
+        rel_margin: float = 0.02,
+        ladder: tuple[int, ...] | None = None,
+    ) -> None:
+        if ladder is None:
+            if knob not in _LADDERS:
+                raise ValueError(
+                    f"unknown autotune knob {knob!r}; known: "
+                    f"{sorted(_LADDERS)}"
+                )
+            ladder = _LADDERS[knob]
+        self.knob = str(knob)
+        self.climb = HillClimb(
+            knob, tuple(ladder), start, window=window, rel_margin=rel_margin
+        )
+        self._last_aux: dict[str, float] = {}
+
+    @property
+    def current(self) -> int:
+        return self.climb.current
+
+    @property
+    def settled(self) -> bool:
+        return self.climb.settled
+
+    def observe(
+        self,
+        duration_s: Optional[float],
+        overlap_efficiency: Optional[float] = None,
+        inflight: Optional[float] = None,
+        mfu: Optional[float] = None,
+    ) -> None:
+        """Record one round's observations. ``duration_s`` comes from the
+        RoundRecord (the score); the rest are gauge reads kept for
+        :meth:`summary`."""
+        if duration_s is not None and duration_s > 0:
+            self.climb.observe(1.0 / float(duration_s))
+        for k, v in (
+            ("overlap_efficiency", overlap_efficiency),
+            ("inflight_rounds", inflight),
+            ("mfu", mfu),
+        ):
+            if v is not None:
+                self._last_aux[k] = float(v)
+
+    def ready(self) -> bool:
+        return self.climb.ready()
+
+    def propose(self) -> int:
+        """Advance the climb if a full window is pending; returns the knob
+        value the driver should use from here on."""
+        return self.climb.step()
+
+    def summary(self) -> dict[str, Any]:
+        """Perf-summary block: chosen knob value, retune/settle state, the
+        full value trajectory, and the last gauge readings seen."""
+        out: dict[str, Any] = {
+            "knob": self.knob,
+            "chosen_" + self.knob: self.current,
+            "retunes": self.climb.retunes,
+            "settled": self.climb.settled,
+            "trajectory": list(self.climb.trajectory),
+            "events": list(self.climb.events),
+        }
+        out.update(self._last_aux)
+        return out

@@ -310,11 +310,17 @@ def make_local_train(
 
 
 def _aggregate(cfg: Config, deltas_trainers: Any) -> Any:
-    """Dispatch to the configured reducer over ``[T, ...]`` stacked deltas."""
+    """Dispatch to the configured reducer over ``[T, ...]`` stacked deltas.
+    ``cfg.pallas_aggregators`` routes the distance-based reducers through
+    the fused kernels where trusted (``ops.pallas_aggregators``); the flag
+    is a no-op for the coordinate-wise ones."""
+    pallas = cfg.pallas_aggregators
     if cfg.aggregator == "krum":
-        return aggregators.krum(deltas_trainers, cfg.byzantine_f)
+        return aggregators.krum(deltas_trainers, cfg.byzantine_f, pallas=pallas)
     if cfg.aggregator == "multi_krum":
-        return aggregators.multi_krum(deltas_trainers, cfg.byzantine_f, cfg.multi_krum_m)
+        return aggregators.multi_krum(
+            deltas_trainers, cfg.byzantine_f, cfg.multi_krum_m, pallas=pallas
+        )
     if cfg.aggregator == "trimmed_mean":
         return aggregators.trimmed_mean(deltas_trainers, cfg.trimmed_mean_beta)
     if cfg.aggregator == "median":
@@ -322,20 +328,27 @@ def _aggregate(cfg: Config, deltas_trainers: Any) -> Any:
     if cfg.aggregator == "geometric_median":
         return aggregators.geometric_median(deltas_trainers)
     if cfg.aggregator == "centered_clip":
-        return aggregators.centered_clip(deltas_trainers, cfg.cclip_tau, cfg.cclip_iters)
+        return aggregators.centered_clip(
+            deltas_trainers, cfg.cclip_tau, cfg.cclip_iters, pallas=pallas
+        )
     if cfg.aggregator == "bulyan":
-        return aggregators.bulyan(deltas_trainers, cfg.byzantine_f)
+        return aggregators.bulyan(deltas_trainers, cfg.byzantine_f, pallas=pallas)
     raise ValueError(f"no gathered-reducer for {cfg.aggregator!r}")
 
 
 def _aggregate_blockwise(cfg: Config, delta: Any, trainer_idx) -> Any:
     """Dispatch to the blockwise (streamed) reducer over local ``[L, ...]``
-    delta blocks inside ``shard_map`` (``ops.sharded_aggregators``)."""
+    delta blocks inside ``shard_map`` (``ops.sharded_aggregators``).
+    ``cfg.pallas_aggregators`` routes the Gram accumulation through the
+    fused kernel where trusted; coordinate-wise reducers are unaffected."""
+    pallas = cfg.pallas_aggregators
     if cfg.aggregator == "krum":
-        return sharded_aggregators.krum_sharded(delta, trainer_idx, cfg.byzantine_f)
+        return sharded_aggregators.krum_sharded(
+            delta, trainer_idx, cfg.byzantine_f, pallas=pallas
+        )
     if cfg.aggregator == "multi_krum":
         return sharded_aggregators.multi_krum_sharded(
-            delta, trainer_idx, cfg.byzantine_f, cfg.multi_krum_m
+            delta, trainer_idx, cfg.byzantine_f, cfg.multi_krum_m, pallas=pallas
         )
     if cfg.aggregator == "trimmed_mean":
         return sharded_aggregators.trimmed_mean_sharded(
@@ -344,13 +357,17 @@ def _aggregate_blockwise(cfg: Config, delta: Any, trainer_idx) -> Any:
     if cfg.aggregator == "median":
         return sharded_aggregators.median_sharded(delta, trainer_idx)
     if cfg.aggregator == "geometric_median":
-        return sharded_aggregators.geometric_median_sharded(delta, trainer_idx)
+        return sharded_aggregators.geometric_median_sharded(
+            delta, trainer_idx, pallas=pallas
+        )
     if cfg.aggregator == "centered_clip":
         return sharded_aggregators.centered_clip_sharded(
-            delta, trainer_idx, cfg.cclip_tau, cfg.cclip_iters
+            delta, trainer_idx, cfg.cclip_tau, cfg.cclip_iters, pallas=pallas
         )
     if cfg.aggregator == "bulyan":
-        return sharded_aggregators.bulyan_sharded(delta, trainer_idx, cfg.byzantine_f)
+        return sharded_aggregators.bulyan_sharded(
+            delta, trainer_idx, cfg.byzantine_f, pallas=pallas
+        )
     raise ValueError(f"no blockwise reducer for {cfg.aggregator!r}")
 
 

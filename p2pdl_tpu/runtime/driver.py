@@ -490,10 +490,17 @@ class Experiment:
         pipeline_depth: int = 2,
         perf: bool = False,
         audit: bool = False,
+        autotune: bool = False,
     ) -> None:
         self.cfg = cfg
         self.attack = attack
         self.byz_ids = tuple(byz_ids)
+        # Overlap autotuner (parallel/autotune.py): hill-climbs
+        # pipeline_depth (run_rounds) or rounds_per_call (run_fused) from
+        # the measured RoundRecord durations. Lazily constructed by
+        # whichever loop runs — the knob depends on the mode.
+        self.autotune = bool(autotune)
+        self._autotuner = None
         # Pipelined round loop (run_rounds/run): eval dispatches async and
         # its scalars — plus the per-peer loss readback — are fetched up to
         # ``pipeline_depth`` rounds late, so rounds r+1..r+k's device work
@@ -1535,10 +1542,42 @@ class Experiment:
                 ),
             )
         self._flush_all_pending()  # a prior pipelined loop may have a tail
+        rpc = int(rounds_per_call)
+        tuner = None
+        if self.autotune:
+            from p2pdl_tpu.parallel.autotune import OverlapAutotuner
+
+            if (
+                self._autotuner is None
+                or self._autotuner.knob != "rounds_per_call"
+            ):
+                self._autotuner = OverlapAutotuner("rounds_per_call", rpc)
+            tuner = self._autotuner
+        # Every distinct scan-block length ever dispatched stays ONE
+        # legitimate compile: retuning rounds_per_call changes the upcoming
+        # schedule, so the sentinel's expected budget is recomputed each
+        # iteration from the sizes already seen plus the remaining
+        # schedule — a retune must never read as a recompile anomaly
+        # (test-pinned in tests/test_autotune.py).
+        if not hasattr(self, "_fused_sizes_seen"):
+            self._fused_sizes_seen = set()
         base_key = jax.random.PRNGKey(self.cfg.seed)
         while int(self.state.round_idx) < self.cfg.rounds:
             r0 = int(self.state.round_idx)
-            block = min(rounds_per_call, self.cfg.rounds - r0)
+            block = min(rpc, self.cfg.rounds - r0)
+            self._fused_sizes_seen.add(block)
+            self.sentinel.expect(
+                "multi_round",
+                max(
+                    1,
+                    len(
+                        self._fused_sizes_seen
+                        | set(
+                            fused_block_sizes(self.cfg.rounds, rpc, start=r0)
+                        )
+                    ),
+                ),
+            )
             sched = self._fused_block_schedule(r0, block)
             trainer_mat = sched["trainer_mat"]
             trainer_dev = jnp.asarray(trainer_mat, jnp.int32)
@@ -1598,6 +1637,28 @@ class Experiment:
                 self.metrics.log(record.to_dict())
                 if on_record is not None:
                     on_record(record)
+            if tuner is not None:
+                if getattr(self, "_autotune_skipped_first", False):
+                    # One observation per ROUND (dt is the block's
+                    # per-round average), so larger blocks fill the tuning
+                    # window proportionally faster.
+                    for _ in range(block):
+                        tuner.observe(
+                            dt,
+                            overlap_efficiency=telemetry.gauge(
+                                "driver.overlap_efficiency"
+                            ).to_value(),
+                            inflight=telemetry.gauge(
+                                "driver.inflight_rounds"
+                            ).to_value(),
+                            mfu=telemetry.gauge("driver.mfu").to_value(),
+                        )
+                else:
+                    # First block carries the jit/XLA compile spike.
+                    self._autotune_skipped_first = True
+                if tuner.ready():
+                    rpc = max(1, int(tuner.propose()))
+                    telemetry.gauge("driver.autotune_rounds_per_call").set(rpc)
             # Same cadence as run(): save iff a checkpoint_every boundary
             # was crossed inside this block (at most one save per block).
             if self.checkpointer is not None and (
@@ -1651,7 +1712,49 @@ class Experiment:
         }
         if self.cost_model is not None:
             out["cost_model"] = self.cost_model.to_dict()
+        if self._autotuner is not None:
+            out["autotune"] = self._autotuner.summary()
         return out
+
+    def _autotune_feed(self, fed: int) -> int:
+        """Feed newly materialized RoundRecords into the overlap autotuner
+        and apply a retuned ``pipeline_depth`` at the next round boundary.
+        Returns the new feed cursor into ``self.records``.
+
+        Observations are the records' measured durations plus gauge reads
+        (attribution only — see ``OverlapAutotuner``); a knob change first
+        drains the in-flight window (a window-size change applies cleanly
+        only to an empty window), which also preserves record order, so
+        the record stream stays bit-identical (minus duration_s) to the
+        untuned run — same contract as pipelining itself."""
+        tuner = self._autotuner
+        if tuner is None or tuner.knob != "pipeline_depth":
+            return len(self.records)
+        while fed < len(self.records):
+            rec = self.records[fed]
+            fed += 1
+            if not getattr(self, "_autotune_skipped_first", False):
+                # The process's first record carries the jit/XLA compile
+                # spike; scoring it would poison the baseline window.
+                self._autotune_skipped_first = True
+                continue
+            tuner.observe(
+                rec.duration_s,
+                overlap_efficiency=telemetry.gauge(
+                    "driver.overlap_efficiency"
+                ).to_value(),
+                inflight=telemetry.gauge("driver.inflight_rounds").to_value(),
+                mfu=telemetry.gauge("driver.mfu").to_value(),
+            )
+        if tuner.ready():
+            new = int(tuner.propose())
+            if new != self.pipeline_depth:
+                self._flush_all_pending()
+                self.pipeline_depth = new
+            telemetry.gauge("driver.autotune_pipeline_depth").set(
+                self.pipeline_depth
+            )
+        return fed
 
     def run_rounds(self, on_record: Optional[Any] = None) -> list[RoundRecord]:
         """The round loop alone (no profiler trace, no final checkpoint —
@@ -1674,11 +1777,20 @@ class Experiment:
                 n += 1
             return n
 
+        if self.autotune and self.pipeline and self._autotuner is None:
+            from p2pdl_tpu.parallel.autotune import OverlapAutotuner
+
+            self._autotuner = OverlapAutotuner(
+                "pipeline_depth", self.pipeline_depth
+            )
+        fed = len(self.records)
         while self._round_cursor < self.cfg.rounds:
             self._run_one_round(defer=self.pipeline)
             emitted = emit()
+            fed = self._autotune_feed(fed)
         self._flush_all_pending()
         emit()
+        self._autotune_feed(fed)
         return self.records
 
     def run(self, on_record: Optional[Any] = None) -> list[RoundRecord]:

@@ -457,3 +457,12 @@ def test_write_baseline_prunes_stale_entries_and_reports_them(tmp_path, capsys):
     assert "0 baselined" in out and "0 stale" in out
     doc = json.loads((tmp_path / "baseline.json").read_text())
     assert doc["entries"] == []
+
+
+def test_new_perf_modules_carry_no_baseline_debt():
+    """The fused-aggregator kernel and the overlap autotuner were written
+    inside the replay/lock discipline from the start: neither module (nor
+    their driver/round wiring) is allowed to lean on the baseline."""
+    fresh = ("pallas_aggregators.py", "autotune.py")
+    for e in load_baseline(DEFAULT_BASELINE_PATH):
+        assert not str(e.get("path", "")).endswith(fresh), e

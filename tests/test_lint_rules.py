@@ -847,3 +847,61 @@ def test_cardinality_out_of_scope_and_suppression():
             telemetry.counter("x", peer=pid).inc()
         """
     assert lint(suppressed, "runtime/fake.py") == []
+
+
+# ---- autotuner replay scope (parallel/autotune.py) --------------------------
+# The overlap autotuner lives under ``parallel/`` and therefore inside the
+# replay-critical scope: its decision rule must be a pure function of the
+# observation stream. These fixtures pin that the scope actually covers the
+# module path — a wall-clock read or entropy draw in a controller would be
+# the classic way to break trajectory reproducibility.
+
+
+def test_autotuner_wallclock_flagged():
+    findings = lint(
+        """
+        import time
+
+        class Controller:
+            def step(self):
+                return time.time()
+        """,
+        "parallel/autotune.py",
+    )
+    assert rules_of(findings) == {"determinism-wallclock"}
+
+
+def test_autotuner_entropy_flagged():
+    findings = lint(
+        """
+        import random
+
+        def propose(ladder):
+            return random.choice(ladder)
+        """,
+        "parallel/autotune.py",
+    )
+    assert rules_of(findings) == {"determinism-entropy"}
+
+
+def test_autotuner_pure_controller_is_clean():
+    """The shape the real HillClimb uses — scores in, deterministic ladder
+    walk out, ``sorted(set(...))`` for canonical ordering — lints clean."""
+    src = """
+        class HillClimb:
+            def __init__(self, ladder, start):
+                self.ladder = tuple(sorted(set(list(ladder) + [start])))
+                self.idx = self.ladder.index(start)
+                self._scores = []
+
+            def observe(self, score):
+                self._scores.append(float(score))
+
+            def step(self):
+                s = sum(self._scores) / len(self._scores)
+                self._scores = []
+                if s > 1.0:
+                    self.idx = min(self.idx + 1, len(self.ladder) - 1)
+                return self.ladder[self.idx]
+        """
+    assert lint(src, "parallel/autotune.py") == []

@@ -398,6 +398,44 @@ def test_cli_perf_diff_leaf_thresholds_for_mfu_and_efficiency(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
 
 
+def test_cli_perf_diff_gates_aggregator_microbench_block(tmp_path):
+    """The fused-vs-dense aggregator block nested inside the headline bench
+    record must reach the gate with its own thresholds: kernel wall-clocks
+    get a 25% band and the derived speedup 20% (single-kernel timing
+    jitter), while the autotuner's chosen knob values / retune counts are
+    measured optima and must NEVER fail the diff."""
+    def record(speedup=2.5, fused_s=0.004, chosen=8, retunes=3):
+        return json.dumps({
+            "metric": "agg_rounds_per_sec_1024peers_mlp", "value": 2000.0,
+            "mfu": 0.85,
+            "aggregators": {
+                "sizes": {"64": {"dense_s": 0.010, "fused_s": fused_s,
+                                 "speedup": speedup}},
+                "chosen_rounds_per_call": chosen, "retunes": retunes,
+            },
+        })
+
+    old = tmp_path / "old.json"
+    old.write_text(record())
+    new = tmp_path / "new.json"
+    for label, text, want in [
+        ("identical", record(), 0),
+        # +15% kernel time: inside the 25% single-kernel jitter band.
+        ("fused_s noise", record(fused_s=0.0046), 0),
+        # A different tuned optimum is the tuner working, not a regression.
+        ("retuned knob", record(chosen=2, retunes=9), 0),
+        # -40% speedup: past the 20% band -> the gate must trip.
+        ("speedup regression", record(speedup=1.5), 1),
+    ]:
+        new.write_text(text)
+        proc = _run(
+            [sys.executable, "-m", "p2pdl_tpu.cli", "perf-diff",
+             "--old", str(old), "--new", str(new)],
+            tmp_path,
+        )
+        assert proc.returncode == want, (label, proc.stdout, proc.stderr[-2000:])
+
+
 def test_cli_perf_diff_usage_errors(tmp_path):
     proc = _run([sys.executable, "-m", "p2pdl_tpu.cli", "perf-diff"], tmp_path)
     assert proc.returncode == 2  # no inputs, no BENCH_r*.json in cwd

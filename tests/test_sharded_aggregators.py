@@ -303,3 +303,185 @@ def test_round_blockwise_matches_gathered(aggregator, mesh8):
         state, _ = fn(state, x, y, trainer_idx, jnp.zeros(c.num_peers), jax.random.PRNGKey(0))
         results.append(state.params)
     _assert_trees_close(results[0], results[1], atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Fused Pallas aggregator kernels (ops.pallas_aggregators). interpret=True
+# runs the SAME kernel body in the Pallas interpreter on CPU, so these
+# dense-Gram oracles police the TPU path without hardware. Tolerances follow
+# the contract in aggregators.PATH_TOLERANCE_ATOL: absolute at O(1) scale,
+# scaled by the magnitude of the values compared (squared distances summed
+# over D features carry O(D) magnitude).
+# ---------------------------------------------------------------------------
+
+from p2pdl_tpu.ops import pallas_aggregators as pa  # noqa: E402
+
+pallas_required = pytest.mark.skipif(
+    not pa._PALLAS_IMPORTED, reason="pallas unavailable on this build"
+)
+
+
+def _scaled_tol(want, atol=aggregators.PATH_TOLERANCE_ATOL):
+    return atol * max(1.0, float(np.max(np.abs(want))))
+
+
+def _dense_d2(x):
+    """Float32 numpy oracle for clamped pairwise squared distances."""
+    x = np.asarray(x, np.float32)
+    g = x @ x.T
+    sq = np.diag(g)
+    return np.maximum(sq[:, None] + sq[None, :] - 2.0 * g, 0.0)
+
+
+@pallas_required
+@pytest.mark.parametrize("t", [8, 16, 33])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_fused_pairwise_sq_dists_matches_dense(t, dtype):
+    """Kernel distances == dense oracle across sublane-unaligned peer counts
+    and a leaf dtype that forces the cast-to-f32-once path."""
+    rng = np.random.default_rng(t)
+    x = jnp.asarray(rng.normal(size=(t, 70)).astype(np.float32)).astype(dtype)
+    got = np.asarray(pa.fused_pairwise_sq_dists(x, interpret=True))
+    want = _dense_d2(np.asarray(x.astype(jnp.float32)))
+    assert got.shape == (t, t)
+    np.testing.assert_allclose(got, want, atol=_scaled_tol(want))
+    # Distances are invariant to the (default all-rows) centering, so the
+    # fused centered assembly must also match the uncentered oracle above.
+
+
+@pallas_required
+@pytest.mark.parametrize("n_center", [1, 5, 16])
+def test_fused_centered_gram_matches_dense_mask(n_center):
+    """Masked centering (the trainer-subset mean block_gram feeds it) ==
+    dense centered Gram, including a single-row center."""
+    rng = np.random.default_rng(n_center)
+    x = rng.normal(size=(16, 300)).astype(np.float32)
+    mask = np.zeros(16, np.float32)
+    mask[rng.permutation(16)[:n_center]] = 1.0
+    got = np.asarray(
+        pa.fused_centered_gram(jnp.asarray(x), jnp.asarray(mask), interpret=True)
+    )
+    mu = (mask[:, None] * x).sum(0) / mask.sum()
+    xc = x - mu[None]
+    want = xc @ xc.T
+    np.testing.assert_allclose(got, want, atol=_scaled_tol(want))
+
+
+@pallas_required
+def test_fused_centered_gram_vacant_mask_clamps():
+    """An all-zero center mask (a fully vacant trainer cohort) must clamp the
+    divisor to 1 — centering on a zero mean, i.e. the raw Gram — instead of
+    dividing by zero."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 130)).astype(np.float32)
+    got = np.asarray(
+        pa.fused_centered_gram(
+            jnp.asarray(x), jnp.zeros(8, jnp.float32), interpret=True
+        )
+    )
+    want = x @ x.T
+    np.testing.assert_allclose(got, want, atol=_scaled_tol(want))
+    assert not np.isnan(got).any()
+
+
+@pallas_required
+def test_fused_gram_uncentered_matches_dense():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(33, 257)).astype(np.float32)
+    got = np.asarray(pa.fused_gram(jnp.asarray(x), interpret=True))
+    want = x @ x.T
+    np.testing.assert_allclose(got, want, atol=_scaled_tol(want))
+
+
+@pallas_required
+def test_fused_rejects_oversized_t():
+    """Past the VMEM accumulator cap the kernel must refuse loudly (callers
+    route to the blockwise XLA path instead)."""
+    x = jnp.zeros((pa.MAX_FUSED_T + 1, 8), jnp.float32)
+    with pytest.raises(ValueError, match="caps T"):
+        pa.fused_pairwise_sq_dists(x, interpret=True)
+
+
+@pallas_required
+def test_gathered_reducers_pallas_flag_matches_xla(delta, monkeypatch):
+    """The pallas=True routing in the gathered reducers (what
+    Config.pallas_aggregators turns on) must reproduce the XLA path within
+    the tolerance contract — exercised here via the interpret-mode test
+    hook, since CPU has no Mosaic."""
+    monkeypatch.setattr(pa, "_FORCE_INTERPRET", True)
+    monkeypatch.setattr(pa, "use_fused", lambda: True)
+    stack = jax.tree.map(lambda d: d[TRAINER_IDX], delta)
+    f = 2
+
+    d2_x = np.asarray(aggregators.pairwise_sq_dists(stack))
+    d2_p = np.asarray(aggregators.pairwise_sq_dists(stack, pallas=True))
+    np.testing.assert_allclose(d2_p, d2_x, atol=_scaled_tol(d2_x))
+
+    for fn in (
+        lambda s, p: aggregators.krum(s, f, pallas=p),
+        lambda s, p: aggregators.multi_krum(s, f, pallas=p),
+        lambda s, p: aggregators.bulyan(s, 1, pallas=p),
+        lambda s, p: aggregators.centered_clip(s, pallas=p),
+    ):
+        _assert_trees_close(
+            fn(stack, True), fn(stack, False),
+            atol=aggregators.PATH_TOLERANCE_ATOL,
+        )
+
+
+@pallas_required
+@pytest.mark.parametrize("center", [False, True])
+def test_block_gram_pallas_matches_xla_path(delta, mesh8, monkeypatch, center):
+    """The sharded fused routing: block_gram(pallas=True) inside shard_map
+    (interpret-mode kernel per gathered chunk) == the XLA chunk path, raw
+    and trainer-centered."""
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("needs jax.shard_map (or the jax_compat shims)")
+    monkeypatch.setattr(pa, "_FORCE_INTERPRET", True)
+    monkeypatch.setattr(pa, "use_fused", lambda: True)
+    cidx = jnp.asarray(TRAINER_IDX, jnp.int32) if center else None
+
+    def run(pallas):
+        fn = functools.partial(
+            sharded_aggregators.block_gram, block=64, center_idx=cidx,
+            pallas=pallas,
+        )
+        return np.asarray(_run_sharded(fn, delta, mesh8))
+
+    want = run(False)
+    got = run(True)
+    np.testing.assert_allclose(got, want, atol=_scaled_tol(want))
+
+
+def test_extract_weighted_accumulates_float32(mesh8):
+    """Regression for the sharded extraction's dtype discipline: the weighted
+    sum over peers must accumulate in FLOAT32 and quantize to the leaf dtype
+    exactly once, so its error vs the float32 oracle is bounded by HALF AN
+    ULP of the result — independent of peer count and weight structure. The
+    old behavior (weight + psum in the leaf dtype) rounds every product and
+    every psum partial, which at this seed lands ~1.5 half-ulps off under
+    the correlated regime (bfloat16 + large common offset) and fails this
+    bound."""
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("needs jax.shard_map (or the jax_compat shims)")
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.default_rng(6)
+    x32 = rng.normal(size=(NUM_PEERS, 300)).astype(np.float32) + 600.0
+    x = jnp.asarray(x32).astype(jnp.bfloat16)
+    w = rng.random(NUM_PEERS).astype(np.float32)
+    w /= w.sum()
+
+    sm = jax.shard_map(
+        lambda d: sharded_aggregators._extract_weighted(
+            d, jnp.asarray(w), PEER_AXIS
+        ),
+        mesh=mesh8,
+        in_specs=(P(PEER_AXIS),),
+        out_specs=P(),
+    )
+    got = np.asarray(jax.jit(sm)({"w": x})["w"], np.float32)
+
+    oracle = (np.asarray(x, np.float32) * w[:, None]).sum(0)
+    half_ulp = 0.5 * 2.0 ** (np.floor(np.log2(np.abs(oracle))) - 7)
+    assert float(np.max(np.abs(got - oracle) / half_ulp)) <= 1.05
