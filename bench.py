@@ -569,6 +569,86 @@ def flight_block() -> dict:
                 rec._ring.extend(prior_events)
 
 
+def tower_block() -> dict:
+    """The bench JSON's ``tower`` block: the control tower tailing three
+    loopback ``serve_metrics`` endpoints that replay the flight probe's
+    recorded stream, with the live merged causal digest checked against the
+    offline ``merge_streams`` digest over the same dumps.
+
+    Mirrors :func:`flight_block` (host-only, recorder state saved/restored);
+    the digest match is the wire-level proof that live tailing loses and
+    reorders nothing relative to the offline audit path.
+    """
+    import hashlib
+    import threading
+
+    from p2pdl_tpu.protocol.audit import causal_digest, merge_streams
+    from p2pdl_tpu.runtime.driver import _TrustPlane
+    from p2pdl_tpu.runtime.server import serve_metrics
+    from p2pdl_tpu.runtime.tower import ControlTower
+    from p2pdl_tpu.utils import flight
+
+    rec = flight.recorder()
+    prior_enabled = rec.enabled
+    prior_events = rec.events()
+    rec.reset()
+    rec.enabled = True
+    streams = []
+    try:
+        cfg = Config(num_peers=8, trainers_per_round=3, byzantine_f=1)
+        trainers = [0, 3, 5]
+        for r in range(3):
+            rec.reset()
+            plane = _TrustPlane(cfg)
+            digests = {
+                t: hashlib.sha256(b"tower-probe-%d-%d" % (r, t)).digest()
+                for t in trainers
+            }
+            plane.run_round(r, trainers, digests)
+            streams.append(rec.events(strip_time=True))
+    finally:
+        rec.reset()
+        rec.enabled = prior_enabled
+        if prior_events:
+            with rec._lock:
+                rec._ring.extend(prior_events)
+
+    servers, urls = [], []
+    try:
+        for evs in streams:
+            replay = flight.FlightRecorder(capacity=8192, enabled=True)
+            for ev in evs:
+                fields = {
+                    k: v for k, v in ev.items() if k not in ("n", "kind", "ts")
+                }
+                replay.record(ev["kind"], **fields)
+            srv = serve_metrics(port=0, recorder=replay)
+            servers.append(srv)
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            urls.append("http://127.0.0.1:%d" % srv.server_address[1])
+        tower = ControlTower(urls, poll_interval=0.02)
+        t0 = time.perf_counter()
+        snap = tower.run_to_exhaustion(max_polls=64)
+        wall_s = time.perf_counter() - t0
+        offline_digest = causal_digest(merge_streams(streams))
+        return {
+            "streams": len(urls),
+            "events_merged": snap["merge"]["emitted"],
+            "late_events": snap["merge"]["late_events"],
+            "gap_events": sum(s["gap_events"] for s in snap["streams"]),
+            "audit_violations": snap["audit"]["violations"],
+            "alerts": sorted(a["rule"] for a in snap["alerts"]),
+            "causal_digest": snap["merge"]["causal_digest"],
+            "digest_matches_offline": (
+                snap["merge"]["causal_digest"] == offline_digest
+            ),
+            "wall_s": round(wall_s, 4),
+        }
+    finally:
+        for srv in servers:
+            srv.shutdown()
+
+
 def aggregator_block() -> dict:
     """The bench JSON's ``aggregators`` block: fused Pallas kernel vs the
     dense XLA Gram path for the ``[T, T]`` pairwise-distance assembly, per
@@ -1500,6 +1580,12 @@ def main() -> None:
         rec["flight"] = flight_block()
     except Exception as e:  # noqa: BLE001 - headline must still print
         rec["flight"] = {"error": str(e)[:300]}
+    # Control-tower live-tail vs offline-merge digest check (ISSUE 13),
+    # same degrade contract.
+    try:
+        rec["tower"] = tower_block()
+    except Exception as e:  # noqa: BLE001 - headline must still print
+        rec["tower"] = {"error": str(e)[:300]}
     # Fused-vs-dense aggregator kernel microbench, same degrade contract.
     try:
         rec["aggregators"] = aggregator_block()

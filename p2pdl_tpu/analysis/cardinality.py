@@ -29,6 +29,9 @@ from typing import Iterable
 
 from p2pdl_tpu.analysis.engine import Finding, ModuleInfo, Rule, register
 
+# "runtime/" deliberately covers runtime/tower.py too: the control tower's
+# tower.* series face the same cardinality discipline as the planes it
+# watches (its per-stream accounting is aggregated, never label-per-stream).
 METRIC_SCOPE = ("protocol/", "parallel/", "runtime/")
 
 # Metric factory call targets: module-level helpers and registry methods.

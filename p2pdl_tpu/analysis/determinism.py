@@ -30,6 +30,14 @@ from p2pdl_tpu.analysis.engine import Finding, ModuleInfo, Rule, register
 
 REPLAY_SCOPE = ("protocol/", "parallel/", "runtime/driver.py")
 
+# The control tower is not replayed state, but its merged-stream digest and
+# health model must be deterministic given the same event prefix — so the
+# wallclock and entropy rules extend to it (operator-facing stamps carry
+# inline suppressions with reasons). Set-order stays replay-scoped: the
+# tower's sorted-traversal discipline is enforced by digest equality tests
+# instead.
+TOWER_SCOPE = REPLAY_SCOPE + ("runtime/tower.py",)
+
 _WALLCLOCK = {"time.time", "time.time_ns"}
 _DT_METHODS = {"now", "utcnow", "today"}
 _ENTROPY_EXACT = {"os.urandom", "uuid.uuid4", "uuid.uuid1"}
@@ -60,7 +68,7 @@ _RANDOM_MODULE_FNS = {
 class WallclockRule(Rule):
     name = "determinism-wallclock"
     description = "wall-clock reads in replay-critical code"
-    scope = REPLAY_SCOPE
+    scope = TOWER_SCOPE
 
     def check(self, mod: ModuleInfo) -> Iterable[Finding]:
         for node in ast.walk(mod.tree):
@@ -92,7 +100,7 @@ class WallclockRule(Rule):
 class EntropyRule(Rule):
     name = "determinism-entropy"
     description = "unseeded randomness in replay-critical code"
-    scope = REPLAY_SCOPE
+    scope = TOWER_SCOPE
 
     def check(self, mod: ModuleInfo) -> Iterable[Finding]:
         for node in ast.walk(mod.tree):

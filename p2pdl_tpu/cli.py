@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 from p2pdl_tpu.config import AGGREGATORS, DATASETS, MODELS, PARTITIONS, Config
 
@@ -25,7 +26,7 @@ def build_parser() -> argparse.ArgumentParser:
         "mode", nargs="?", default="run",
         choices=[
             "run", "serve", "serve-metrics", "bench", "report", "chaos",
-            "lint", "perf-diff", "audit",
+            "lint", "perf-diff", "audit", "tower", "divergence",
         ],
     )
     p.add_argument("--num-peers", type=int, default=8)
@@ -326,7 +327,37 @@ def build_parser() -> argparse.ArgumentParser:
         "--inputs", action="append", default=None, metavar="SRC",
         help="audit mode: an event stream to merge — a flight JSONL dump "
         "path or a live server base URL (http://host:port, its /flight "
-        "endpoint is scraped); repeatable, one per peer process",
+        "endpoint is scraped); repeatable, one per peer process. "
+        "tower mode: a live endpoint base URL to tail; repeatable. "
+        "divergence mode: exactly two recorded streams (flight JSONL "
+        "dumps or RoundRecord JSONLs) to align and diff",
+    )
+    p.add_argument(
+        "--interval", type=float, default=0.5, metavar="S",
+        help="tower mode: poll interval in seconds between endpoint sweeps",
+    )
+    p.add_argument(
+        "--once", action="store_true",
+        help="tower mode: tail every endpoint to exhaustion, finalize the "
+        "merge, print one report, and exit (replay/CI mode) instead of "
+        "polling until interrupted",
+    )
+    p.add_argument(
+        "--archive", default=None, metavar="PATH",
+        help="tower mode: append every merged event (causal order, "
+        "time-stripped JSONL) here, sealed by a trailer line carrying the "
+        "rolling causal digest",
+    )
+    p.add_argument(
+        "--kind", default=None, metavar="K[,K]",
+        help="tower mode: server-side /flight?kind= filter — tail only "
+        "these event kinds (note: the causal digest then covers only the "
+        "filtered events)",
+    )
+    p.add_argument(
+        "--max-polls", type=int, default=64, metavar="N",
+        help="tower --once: upper bound on poll sweeps before finalizing "
+        "(a flapping endpoint cannot wedge the exit)",
     )
     p.add_argument(
         "--registered-peers", type=int, default=None, metavar="N",
@@ -1164,6 +1195,128 @@ def run_audit(args: argparse.Namespace) -> int:
     return 1 if violations else 0
 
 
+def run_tower(args: argparse.Namespace) -> int:
+    """Cluster control tower: tail N live observability endpoints, merge
+    their flight streams causally, audit incrementally, and render the
+    cluster-health dashboard. Exit 1 on audit violations, 2 on usage
+    errors — pure host path, no jax import."""
+    from p2pdl_tpu.runtime.tower import ControlTower
+
+    endpoints = list(args.inputs or [])
+    if not endpoints:
+        _warn(
+            "tower mode needs --inputs (http://host:port endpoint base "
+            "URL; repeatable, one per peer process)"
+        )
+        return 2
+    kinds = None
+    if args.kind:
+        kinds = [k for k in args.kind.split(",") if k]
+    try:
+        tower = ControlTower(
+            endpoints,
+            poll_interval=args.interval,
+            kinds=kinds,
+            registered=(
+                range(args.registered_peers)
+                if args.registered_peers is not None
+                else None
+            ),
+            archive_path=args.archive,
+        )
+    except OSError as e:
+        _warn(f"tower could not open --archive: {e}")
+        return 2
+
+    def emit(snap: dict) -> None:
+        if args.lint_json:
+            json.dump(snap, sys.stdout, sort_keys=True)
+            sys.stdout.write("\n")
+        else:
+            sys.stdout.write(tower.render_dashboard() + "\n")
+        sys.stdout.flush()
+
+    if args.once:
+        snap = tower.run_to_exhaustion(max_polls=max(1, args.max_polls))
+        emit(snap)
+        return 1 if snap["audit"]["violations"] else 0
+    try:
+        while True:
+            emit(tower.poll_once())
+            time.sleep(tower.poll_interval)
+    except KeyboardInterrupt:
+        pass
+    snap = tower.finalize()
+    emit(snap)
+    return 1 if snap["audit"]["violations"] else 0
+
+
+def run_divergence(args: argparse.Namespace) -> int:
+    """First-divergence forensics between two recorded streams: align by
+    the canonical causal key, report the first differing event with a
+    field-level diff and (for flight streams) the causal blame chain.
+    Exit 0 identical, 1 divergent, 2 usage — pure host path, no jax."""
+    from p2pdl_tpu.runtime.tower import diverge, load_jsonl
+
+    inputs = list(args.inputs or [])
+    if len(inputs) != 2:
+        _warn(
+            "divergence mode needs exactly two --inputs (flight JSONL "
+            "dumps or RoundRecord JSONLs)"
+        )
+        return 2
+    try:
+        a_events = load_jsonl(inputs[0])
+        b_events = load_jsonl(inputs[1])
+    except (OSError, ValueError) as e:
+        _warn(f"divergence could not load inputs: {e}")
+        return 2
+    report = diverge(a_events, b_events)
+    report["inputs"] = {"a": inputs[0], "b": inputs[1]}
+    if args.lint_json:
+        json.dump(report, sys.stdout, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0 if report["identical"] else 1
+    if report["identical"]:
+        sys.stdout.write(
+            f"streams identical: {report['a_len']} aligned "
+            f"{report['kind']} events\n"
+        )
+        return 0
+    lines = [
+        f"# divergence: first differing {report['kind']} event at aligned "
+        f"index {report['index']} (a: {report['a_len']} events, "
+        f"b: {report['b_len']})",
+        "",
+    ]
+    first = report["first_divergent"]
+    if "only_in" in first:
+        lines.append(
+            f"stream {first['only_in']} has extra events from index "
+            f"{report['index']}:"
+        )
+        lines.append(f"  {json.dumps(first[first['only_in']], sort_keys=True)}")
+    else:
+        ev = first["a"]
+        label = ev.get("kind", f"round {ev.get('round')}")
+        lines.append(f"first divergent event: {label}")
+        for field, d in sorted(first["diff"].items()):
+            lines.append(f"  {field}: a={d['a']!r}  b={d['b']!r}")
+    chain = report.get("blame_chain") or []
+    if chain:
+        lines += ["", f"causal blame chain ({len(chain)} link(s), earliest first):"]
+        for i, link in enumerate(chain):
+            ev = link["a"]
+            where = (
+                f"{ev.get('kind')} peer={ev.get('peer')} "
+                f"lamport={ev.get('lamport')} n={ev.get('n')}"
+            )
+            fields = ", ".join(sorted(link["diff"])) or "(cause tag only)"
+            lines.append(f"  [{i}] {where}: differs in {fields}")
+    sys.stdout.write("\n".join(lines) + "\n")
+    return 1
+
+
 def run_report(args: argparse.Namespace) -> int:
     from p2pdl_tpu.utils.metrics import load_results
 
@@ -1243,6 +1396,13 @@ def main(argv: list[str] | None = None) -> int:
     if args.mode == "audit":
         # Pure host path: stream merge + invariant checks, stdlib-json only.
         return run_audit(args)
+    if args.mode == "tower":
+        # Pure host path: the control tower tails remote processes over
+        # HTTP; it must never pay a jax import itself.
+        return run_tower(args)
+    if args.mode == "divergence":
+        # Pure host path: JSONL alignment + diff, stdlib-json only.
+        return run_divergence(args)
     if args.mode == "lint":
         # Pure host path: p2plint is stdlib-ast only, no jax/backend init.
         from p2pdl_tpu.analysis import cli_lint

@@ -47,7 +47,9 @@ __all__ = [
     "INVARIANTS",
     "Violation",
     "ProtocolAuditor",
+    "merge_key",
     "merge_streams",
+    "StreamingMerger",
     "causal_digest",
 ]
 
@@ -404,31 +406,145 @@ class ProtocolAuditor:
         }
 
 
+def merge_key(ev: dict, stream_index: int) -> tuple[int, int, int, int]:
+    """The canonical causal-merge sort key ``(round, lamport, stream, n)``.
+
+    Round groups the protocol phases, the Lamport time orders
+    causally-related events within a round (a receive always sorts after
+    its send), and the (stream, n) tail breaks the remaining concurrency
+    ties identically on every run. Shared by the offline ``merge_streams``,
+    the tower's ``StreamingMerger``, and divergence alignment so all three
+    agree on what "the same position" means.
+    """
+    lamport = ev.get("lamport")
+    return (
+        _round_of(ev),
+        int(lamport) if isinstance(lamport, int) else -1,
+        stream_index,
+        int(ev.get("n", 0)),
+    )
+
+
 def merge_streams(streams: list[list[dict]]) -> list[dict]:
     """Deterministically merge N per-process event streams into one.
 
-    Sort key: ``(round, lamport, stream index, local n)`` — round groups
-    the protocol phases, the Lamport time orders causally-related events
-    within a round (a receive always sorts after its send), and the
-    (stream, n) tail breaks the remaining concurrency ties identically on
-    every run. The auditor's checks are order-insensitive; the merged order
-    exists so ``causal_digest`` is a stable cross-peer fingerprint.
+    Sorts by ``merge_key``. The auditor's checks are order-insensitive;
+    the merged order exists so ``causal_digest`` is a stable cross-peer
+    fingerprint.
     """
     keyed = []
     for si, evs in enumerate(streams):
         for ev in evs:
-            lamport = ev.get("lamport")
-            keyed.append(
-                (
-                    _round_of(ev),
-                    int(lamport) if isinstance(lamport, int) else -1,
-                    si,
-                    int(ev.get("n", 0)),
-                    ev,
-                )
-            )
-    keyed.sort(key=lambda t: t[:4])
-    return [t[4] for t in keyed]
+            keyed.append((merge_key(ev, si), ev))
+    keyed.sort(key=lambda t: t[0])
+    return [t[1] for t in keyed]
+
+
+class StreamingMerger:
+    """Incremental ``merge_streams``: per-stream buffers + round watermarks.
+
+    ``push(stream, events)`` buffers a batch from one stream (events arrive
+    in local ``n`` order but *not* key order — a depth-k pipeline flushes
+    round ``r`` events up to k rounds late, and ``membership`` stop events
+    carry no round at all). ``poll()`` emits, in global ``merge_key`` order,
+    every buffered event whose round coordinate is strictly below the
+    *frontier* — ``min`` over live (non-closed) streams of the largest round
+    seen, minus ``hold_rounds`` of pipeline slack — because a stream that
+    has shown round ``W`` can still produce events for rounds down to
+    ``W - hold_rounds`` but no lower. ``close(stream)`` removes a stream
+    from the frontier; ``finalize()`` closes everything and drains.
+
+    The rolling ``digest()`` folds each emitted event (time-stripped,
+    sorted-keys JSON — exactly ``causal_digest``'s encoding) in emission
+    order. As long as no *late* event arrives (key at or below the last
+    emitted key — ``late_events`` counts them), the emitted sequence is
+    bit-identical to ``merge_streams`` over the same events, so the rolling
+    digest equals the offline ``causal_digest`` at every prefix and, after
+    ``finalize()``, over the whole run.
+    """
+
+    def __init__(self, n_streams: int, hold_rounds: int = 2) -> None:
+        if n_streams < 1:
+            raise ValueError("StreamingMerger needs at least one stream")
+        self.n_streams = n_streams
+        self.hold_rounds = max(0, int(hold_rounds))
+        self._pending: list[tuple[tuple[int, int, int, int], dict]] = []
+        # Largest round coordinate seen per stream; -2 = nothing yet (so a
+        # silent stream holds the frontier below every real round, incl. -1).
+        self._max_round = [-2] * n_streams
+        self._closed = [False] * n_streams
+        self._last_key: Optional[tuple[int, int, int, int]] = None
+        self._hash = hashlib.sha256()
+        self.emitted = 0
+        self.late_events = 0
+        self.buffered_high_water = 0
+
+    def push(self, stream_index: int, events: Iterable[dict]) -> int:
+        """Buffer one batch from ``stream_index``; returns events accepted."""
+        if not 0 <= stream_index < self.n_streams:
+            raise IndexError(f"stream {stream_index} out of range")
+        count = 0
+        for ev in events:
+            key = merge_key(ev, stream_index)
+            self._pending.append((key, ev))
+            if key[0] > self._max_round[stream_index]:
+                self._max_round[stream_index] = key[0]
+            count += 1
+        self.buffered_high_water = max(self.buffered_high_water, len(self._pending))
+        return count
+
+    def close(self, stream_index: int) -> None:
+        """Mark a stream complete: it no longer holds back the frontier."""
+        self._closed[stream_index] = True
+
+    @property
+    def frontier(self) -> Optional[int]:
+        """Exclusive round bound below which emission is safe; None when
+        every stream is closed (everything buffered is safe)."""
+        live = [
+            self._max_round[i]
+            for i in range(self.n_streams)
+            if not self._closed[i]
+        ]
+        if not live:
+            return None
+        return min(live) - self.hold_rounds
+
+    def poll(self) -> list[dict]:
+        """Emit the safe sorted prefix of the buffered events."""
+        frontier = self.frontier
+        if frontier is None:
+            ready, self._pending = self._pending, []
+        else:
+            ready = [kv for kv in self._pending if kv[0][0] < frontier]
+            if not ready:
+                return []
+            self._pending = [kv for kv in self._pending if kv[0][0] >= frontier]
+        ready.sort(key=lambda kv: kv[0])
+        out = []
+        for key, ev in ready:
+            if self._last_key is not None and key <= self._last_key:
+                # Ordered emission already passed this key: the event still
+                # flows downstream (the auditor is order-insensitive) but the
+                # rolling digest can no longer match the offline merge.
+                self.late_events += 1
+            else:
+                self._last_key = key
+            stripped = {k: v for k, v in ev.items() if k != "ts"}
+            self._hash.update(json.dumps(stripped, sort_keys=True).encode())
+            self.emitted += 1
+            out.append(ev)
+        return out
+
+    def finalize(self) -> list[dict]:
+        """Close every stream and drain the remaining buffer in order."""
+        for i in range(self.n_streams):
+            self._closed[i] = True
+        return self.poll()
+
+    def digest(self) -> str:
+        """Rolling causal digest over everything emitted so far."""
+        return self._hash.copy().hexdigest()
 
 
 def causal_digest(events: Iterable[dict]) -> str:

@@ -30,6 +30,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional
+from urllib.parse import unquote
 
 from p2pdl_tpu.config import Config
 from p2pdl_tpu.utils import flight, telemetry
@@ -44,16 +45,35 @@ FLIGHT_PAGE_LIMIT_MAX = 2048
 
 def _flight_page_params(
     query: str,
-) -> tuple[Optional[dict[str, int]], Optional[str]]:
-    """Parse ``since``/``limit`` from a /flight query string; returns
-    ``(params, None)`` or ``(None, error)`` — the PR 6 error matrix says a
-    bad request gets a JSON body naming the problem, not a silent default."""
-    params = {"since": 0, "limit": FLIGHT_PAGE_LIMIT}
+) -> tuple[Optional[dict[str, Any]], Optional[str]]:
+    """Parse ``since``/``limit``/``kind`` from a /flight query string;
+    returns ``(params, None)`` or ``(None, error)`` — the PR 6 error matrix
+    says a bad request gets a JSON body naming the problem, not a silent
+    default. ``kind`` is a comma-separated subset of ``flight.KNOWN_KINDS``
+    (a typo'd filter fails loudly instead of tailing nothing)."""
+    params: dict[str, Any] = {
+        "since": 0,
+        "limit": FLIGHT_PAGE_LIMIT,
+        "kinds": None,
+    }
     for part in query.split("&"):
         if not part:
             continue
         key, sep, raw = part.partition("=")
-        if key not in params or not sep:
+        raw = unquote(raw)  # standard clients %-encode the kind-list commas
+        if key == "kind" and sep:
+            kinds = tuple(k for k in raw.split(",") if k)
+            if not kinds:
+                return None, "/flight ?kind must name at least one event kind"
+            unknown = sorted(set(kinds) - set(flight.KNOWN_KINDS))
+            if unknown:
+                return None, (
+                    "/flight ?kind names unknown event kind(s): "
+                    + ", ".join(unknown)
+                )
+            params["kinds"] = kinds
+            continue
+        if key not in ("since", "limit") or not sep:
             return None, f"unknown /flight query parameter: {part!r}"
         try:
             val = int(raw)
@@ -119,35 +139,53 @@ def _observability_get(
     path: str,
     snapshot_fn: Callable[[], dict],
     extra_health: Optional[Callable[[], dict]] = None,
+    recorder: Optional[flight.FlightRecorder] = None,
 ) -> Optional[tuple[int, str, bytes]]:
     """Route the shared observability GETs; returns ``(status, content_type,
-    body)`` or None when ``path`` is not an observability endpoint."""
+    body)`` or None when ``path`` is not an observability endpoint.
+
+    ``recorder`` defaults to the process-wide flight recorder; the replay
+    path (``cli serve-metrics --flight-path``, the tower's tests/bench)
+    passes a dedicated instance so one process can expose N distinct
+    recorded streams on N ports."""
     path, _, query = path.partition("?")
     if path == "/metrics":
         body = telemetry.render_prometheus(snapshot_fn()).encode()
         return 200, PROMETHEUS_CONTENT_TYPE, body
+    rec = recorder if recorder is not None else flight.recorder()
     if path == "/healthz":
-        rec = flight.recorder()
         payload: dict[str, Any] = {
             "status": "ok",
             "anomaly_count": rec.anomaly_count,
             "anomalies_by_kind": dict(sorted(rec.anomalies_by_kind.items())),
         }
+        # Cheap training-progress liveness (no /metrics scrape needed):
+        # the driver's round gauges, absent until the first round lands.
+        gauges = snapshot_fn().get("gauges", {})
+        for field, series in (
+            ("round_index", "driver.round_index"),
+            ("rounds_per_sec", "driver.rounds_per_sec"),
+        ):
+            if series in gauges:
+                payload[field] = gauges[series]
         if extra_health is not None:
             payload.update(extra_health())
         return 200, "application/json", json.dumps(payload).encode()
     if path == "/flight":
-        rec = flight.recorder()
         if query:
             # Cursor-paged tail: ?since=<n> resumes where the last scrape
             # stopped, ?limit bounds the page (default FLIGHT_PAGE_LIMIT,
-            # hard cap FLIGHT_PAGE_LIMIT_MAX) — live tailing without
-            # re-shipping the whole ring each scrape.
+            # hard cap FLIGHT_PAGE_LIMIT_MAX), ?kind=a,b filters
+            # server-side — live tailing without re-shipping the whole
+            # ring each scrape.
             params, err = _flight_page_params(query)
             if err is not None:
                 return 400, "application/json", json.dumps({"error": err}).encode()
             payload = rec.events_page(
-                since=params["since"], limit=params["limit"], strip_time=True
+                since=params["since"],
+                limit=params["limit"],
+                strip_time=True,
+                kinds=params["kinds"],
             )
             payload["summary"] = rec.summary()
             return 200, "application/json", json.dumps(payload).encode()
@@ -270,13 +308,17 @@ def serve_metrics(
     host: str = "127.0.0.1",
     port: int = 9090,
     snapshot_fn: Optional[Callable[[], dict]] = None,
+    recorder: Optional[flight.FlightRecorder] = None,
 ) -> ThreadingHTTPServer:
     """Standalone exposition server: ``/metrics`` + ``/healthz`` +
     ``/flight`` with no orchestrator (and no jax import) attached.
 
     ``snapshot_fn`` defaults to the live process registry; ``cli
     serve-metrics --telemetry-path`` passes a loader over a snapshot JSON on
-    disk instead, turning any recorded run into a scrape target."""
+    disk instead, turning any recorded run into a scrape target.
+    ``recorder`` likewise defaults to the process-wide flight recorder; a
+    dedicated instance lets one process replay N distinct recorded streams
+    on N ports (the tower's test/bench topology)."""
     if snapshot_fn is None:
         snapshot_fn = telemetry.snapshot
 
@@ -285,7 +327,7 @@ def serve_metrics(
             self._guarded(self._get)
 
         def _get(self) -> None:
-            routed = _observability_get(self.path, snapshot_fn)
+            routed = _observability_get(self.path, snapshot_fn, recorder=recorder)
             if routed is not None:
                 self._send(*routed)
             else:

@@ -1,0 +1,743 @@
+"""Cluster control tower: live multi-peer flight tailing + streaming audit.
+
+The per-process observability pieces (flight recorder with cursor paging,
+Prometheus exposition, Lamport-tagged causal merge, conformance auditor)
+only became a *cluster* plane once something consumes N of them at once.
+This module is that consumer, and it is deliberately jax-free — a tower
+runs on an operator laptop against training hosts, never inside one.
+
+- :class:`ControlTower` tails N ``/flight?since=`` cursor endpoints
+  (bounded deterministic backoff, per-stream watermarks, ring-eviction gap
+  accounting via the page's ``oldest_retained``), scrapes ``/metrics`` and
+  ``/healthz``, merges the streams *incrementally* through
+  :class:`p2pdl_tpu.protocol.audit.StreamingMerger` (so the rolling
+  ``causal_digest`` is bit-identical to the offline ``cli audit`` merge
+  over the same events), feeds every merged event into a live
+  :class:`ProtocolAuditor`, and maintains a deterministic cluster-health
+  model (committee size, min quorum margin, suspicion set, anomaly counts,
+  round-progress SLO) with threshold alert rules.
+- :func:`diverge` + :func:`blame_chain` are the forensics half: align two
+  recorded streams by the canonical ``(round, lamport, stream, n)`` key,
+  report the first divergent event with a field-level diff, then walk the
+  ``cause`` edges (``"peer:lamport"`` trace tags) backwards to the
+  earliest upstream event that already differs.
+
+Determinism: everything derived from event *content* is pure bookkeeping
+(sorted traversals, no entropy). The poll loop itself lives on
+``time.perf_counter`` — the sanctioned monotonic clock — for pacing,
+backoff, and SLO stall measurement; the only wall-clock reads are
+operator-facing stamps on the dashboard and the archive trailer, each
+carrying an inline lint suppression with its reason.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Any, Callable, Iterable, Optional
+
+from p2pdl_tpu.protocol.audit import (
+    ProtocolAuditor,
+    StreamingMerger,
+    merge_key,
+)
+from p2pdl_tpu.utils import telemetry
+
+__all__ = [
+    "TowerSLO",
+    "StreamTail",
+    "ControlTower",
+    "load_jsonl",
+    "stream_kind",
+    "field_diff",
+    "diverge",
+    "blame_chain",
+]
+
+# Poll-loop bounds: a failing endpoint backs off exponentially (factor 2,
+# deterministic — no jitter, the fleet is N<=dozens of laptops' towers, not
+# a thundering herd) up to BACKOFF_CAP_S; a healthy stream is drained at
+# most MAX_PAGES_PER_POLL pages per sweep so one chatty peer cannot starve
+# the others.
+BACKOFF_CAP_S = 30.0
+MAX_PAGES_PER_POLL = 64
+DOWN_AFTER_ERRORS = 3
+
+
+class TowerSLO:
+    """Threshold alert rules over the cluster-health model.
+
+    Every rule is a pure predicate over deterministic state, so the alert
+    set for a given event prefix is identical on every run. ``None``
+    disables a rule.
+    """
+
+    def __init__(
+        self,
+        round_stall_s: Optional[float] = 60.0,
+        min_quorum_margin: Optional[int] = 1,
+        max_anomalies_per_round: Optional[float] = 1.0,
+    ) -> None:
+        self.round_stall_s = round_stall_s
+        self.min_quorum_margin = min_quorum_margin
+        self.max_anomalies_per_round = max_anomalies_per_round
+
+
+class StreamTail:
+    """Mutable tail state for one endpoint: cursor, gaps, backoff, health."""
+
+    def __init__(self, url: str) -> None:
+        self.url = url.rstrip("/")
+        if "://" not in self.url:
+            self.url = "http://" + self.url
+        self.cursor = 0
+        self.events_ingested = 0
+        self.gap_events = 0  # history lost to ring eviction, exactly
+        self.errors = 0
+        self.consecutive_errors = 0
+        self.next_attempt = 0.0  # perf_counter deadline for backoff
+        self.drained = False  # last sweep saw an empty page
+        self.closed = False
+        self.last_health: dict[str, Any] = {}
+        self.last_metrics: dict[str, float] = {}
+
+    @property
+    def down(self) -> bool:
+        return self.consecutive_errors >= DOWN_AFTER_ERRORS
+
+    def state(self) -> str:
+        if self.closed:
+            return "closed"
+        if self.down:
+            return "down"
+        if self.drained:
+            return "drained"
+        return "tailing"
+
+
+class ControlTower:
+    """Tail N observability endpoints into one audited causal stream.
+
+    ``endpoints`` are ``host:port`` or full ``http://`` base URLs exposing
+    the ``serve_metrics`` surface. ``kinds`` optionally narrows the tail to
+    a server-side ``?kind=`` filter (note: a filtered tail is cheaper but
+    its causal digest covers only the filtered events). ``registered`` is
+    the auditor's voter universe, as in ``cli audit``.
+    """
+
+    def __init__(
+        self,
+        endpoints: list[str],
+        poll_interval: float = 0.5,
+        kinds: Optional[Iterable[str]] = None,
+        registered: Optional[Iterable[int]] = None,
+        slo: Optional[TowerSLO] = None,
+        hold_rounds: int = 2,
+        http_timeout: float = 3.0,
+        page_limit: int = 512,
+        archive_path: Optional[str] = None,
+        fetch_json: Optional[Callable[[str, float], Any]] = None,
+    ) -> None:
+        if not endpoints:
+            raise ValueError("ControlTower needs at least one endpoint")
+        self.tails = [StreamTail(u) for u in endpoints]
+        self.poll_interval = max(0.01, float(poll_interval))
+        self.kinds = tuple(kinds) if kinds else None
+        self.slo = slo if slo is not None else TowerSLO()
+        self.http_timeout = float(http_timeout)
+        self.page_limit = int(page_limit)
+        self.merger = StreamingMerger(len(self.tails), hold_rounds=hold_rounds)
+        self.auditor = ProtocolAuditor(registered=registered)
+        self._fetch_json = fetch_json if fetch_json is not None else _http_json
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.polls = 0
+        self.finalized = False
+        # Cluster-health model (all derived from merged event content).
+        self.max_round = -1
+        self._round_advanced_at = time.perf_counter()
+        self.committee: Optional[int] = None
+        self.live: Optional[int] = None
+        self.suspected: list[int] = []
+        self.min_quorum_margin: Optional[int] = None
+        self.anomalies_by_kind: dict[str, int] = {}
+        self._archive = open(archive_path, "w") if archive_path else None
+        self.archive_path = archive_path
+
+    # ---- transport -----------------------------------------------------------
+
+    def _flight_url(self, tail: StreamTail) -> str:
+        url = f"{tail.url}/flight?since={tail.cursor}&limit={self.page_limit}"
+        if self.kinds:
+            url += "&kind=" + ",".join(self.kinds)
+        return url
+
+    def _sweep_stream(self, index: int, tail: StreamTail) -> None:
+        """One poll sweep over a single endpoint: drain flight pages into
+        the merger, then refresh its health/metrics snapshots."""
+        first_page = True
+        for _ in range(MAX_PAGES_PER_POLL):
+            page = self._fetch_json(self._flight_url(tail), self.http_timeout)
+            events = page.get("events", [])
+            oldest = page.get("oldest_retained")
+            if first_page and oldest is not None and oldest > tail.cursor:
+                # The ring evicted history past our cursor: account the
+                # loss exactly (the recorder's monotone `n` makes the gap
+                # arithmetic precise even under a ?kind= filter).
+                if tail.cursor > 0 or tail.events_ingested > 0:
+                    tail.gap_events += oldest - tail.cursor
+                tail.cursor = oldest
+            first_page = False
+            next_cursor = page.get("next_cursor", tail.cursor)
+            if events:
+                self.merger.push(index, events)
+                tail.events_ingested += len(events)
+                telemetry.counter("tower.events_ingested").inc(len(events))
+            if next_cursor <= tail.cursor:
+                tail.drained = True
+                break
+            tail.cursor = next_cursor
+            if not events and next_cursor >= page.get("events_recorded", 0):
+                tail.drained = True
+                break
+        else:
+            tail.drained = False
+        health = self._fetch_json(f"{tail.url}/healthz", self.http_timeout)
+        if isinstance(health, dict):
+            tail.last_health = health
+        metrics = self._fetch_json(f"{tail.url}/metrics", self.http_timeout)
+        if isinstance(metrics, str):
+            tail.last_metrics = telemetry.parse_prometheus_text(metrics)
+
+    # ---- polling -------------------------------------------------------------
+
+    def poll_once(self) -> dict[str, Any]:
+        """One synchronous sweep over every stream; returns ``snapshot()``."""
+        with self._lock:
+            now = time.perf_counter()
+            self.polls += 1
+            telemetry.counter("tower.polls").inc()
+            for i, tail in enumerate(self.tails):
+                if tail.closed or tail.next_attempt > now:
+                    continue
+                try:
+                    self._sweep_stream(i, tail)
+                except Exception:
+                    tail.errors += 1
+                    tail.consecutive_errors += 1
+                    tail.drained = False
+                    telemetry.counter("tower.poll_errors").inc()
+                    # Deterministic bounded exponential backoff (no jitter).
+                    delay = min(
+                        BACKOFF_CAP_S,
+                        self.poll_interval
+                        * (2 ** min(tail.consecutive_errors, 6)),
+                    )
+                    tail.next_attempt = time.perf_counter() + delay
+                else:
+                    tail.consecutive_errors = 0
+                    tail.next_attempt = 0.0
+            for ev in self.merger.poll():
+                self._observe(ev)
+            self.auditor.check()
+            self._update_gauges()
+            return self.snapshot()
+
+    def close_stream(self, index: int) -> None:
+        """Stop tailing one endpoint and release its merge watermark."""
+        with self._lock:
+            self.tails[index].closed = True
+            self.merger.close(index)
+
+    def finalize(self) -> dict[str, Any]:
+        """Close every stream, drain the merger, run the final audit pass,
+        and seal the archive; returns the final ``snapshot()``."""
+        with self._lock:
+            if not self.finalized:
+                self.finalized = True
+                for tail in self.tails:
+                    tail.closed = True
+                for ev in self.merger.finalize():
+                    self._observe(ev)
+                self.auditor.check()
+                self._update_gauges()
+                if self._archive is not None:
+                    trailer = {
+                        "tower_archive": {
+                            "causal_digest": self.merger.digest(),
+                            "emitted": self.merger.emitted,
+                            "late_events": self.merger.late_events,
+                        },
+                        # Operator-facing stamp, never replayed state.
+                        "ts": time.time(),  # p2plint: disable=determinism-wallclock -- archive trailer wall-clock stamp for the human reader; stripped (like every `ts`) from all comparisons
+                    }
+                    self._archive.write(
+                        json.dumps(trailer, sort_keys=True) + "\n"
+                    )
+                    self._archive.close()
+                    self._archive = None
+            return self.snapshot()
+
+    def run(self, max_polls: Optional[int] = None) -> None:
+        """Blocking poll loop until ``stop()`` (or ``max_polls`` sweeps)."""
+        done = 0
+        while not self._stop.is_set():
+            self.poll_once()
+            done += 1
+            if max_polls is not None and done >= max_polls:
+                break
+            self._stop.wait(self.poll_interval)
+
+    def start(self) -> threading.Thread:
+        """Run the poll loop on a daemon thread; returns the thread."""
+        if self._thread is not None:
+            raise RuntimeError("tower already started")
+        self._thread = threading.Thread(
+            target=self.run, name="p2pdl-tower", daemon=True
+        )
+        self._thread.start()
+        return self._thread
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def run_to_exhaustion(
+        self, max_polls: int = 64, settle_polls: int = 2
+    ) -> dict[str, Any]:
+        """Poll until every live stream reports a drained tail for
+        ``settle_polls`` consecutive sweeps (the ``--once`` replay mode),
+        then finalize. Bounded by ``max_polls`` so a flapping endpoint
+        cannot wedge the caller."""
+        settled = 0
+        for _ in range(max_polls):
+            self.poll_once()
+            if all(t.closed or t.down or t.drained for t in self.tails):
+                settled += 1
+                if settled >= settle_polls:
+                    break
+            else:
+                settled = 0
+        return self.finalize()
+
+    # ---- health model --------------------------------------------------------
+
+    def _observe(self, ev: dict[str, Any]) -> None:
+        """Fold one merged event into the health model (and the archive)."""
+        kind = ev.get("kind")
+        r = merge_key(ev, 0)[0]
+        if r > self.max_round:
+            self.max_round = r
+            self._round_advanced_at = time.perf_counter()
+        if kind == "quorum_reconfig" or kind == "quorum_collapse":
+            if ev.get("live") is not None:
+                self.live = int(ev["live"])
+            if ev.get("committee") is not None:
+                self.committee = int(ev["committee"])
+            if ev.get("suspected") is not None:
+                self.suspected = sorted(int(p) for p in ev["suspected"])
+        elif kind == "suspect":
+            p = ev.get("peer")
+            if p is not None and int(p) not in self.suspected:
+                self.suspected = sorted(self.suspected + [int(p)])
+        elif kind == "unsuspect":
+            p = ev.get("peer")
+            if p is not None and int(p) in self.suspected:
+                self.suspected = [q for q in self.suspected if q != int(p)]
+        elif kind == "brb_deliver":
+            margin = ev.get("margin")
+            if margin is not None and (
+                self.min_quorum_margin is None
+                or int(margin) < self.min_quorum_margin
+            ):
+                self.min_quorum_margin = int(margin)
+        if ev.get("anomaly") and kind is not None:
+            self.anomalies_by_kind[kind] = (
+                self.anomalies_by_kind.get(kind, 0) + 1
+            )
+        self.auditor.feed(ev)
+        if self._archive is not None:
+            stripped = {k: v for k, v in ev.items() if k != "ts"}
+            self._archive.write(json.dumps(stripped, sort_keys=True) + "\n")
+
+    def round_stall_s(self) -> float:
+        """Seconds since the merged round coordinate last advanced."""
+        return time.perf_counter() - self._round_advanced_at
+
+    def rounds_per_sec(self) -> Optional[float]:
+        """Slowest live peer's reported round rate (None before any report)."""
+        rates = [
+            float(t.last_health["rounds_per_sec"])
+            for t in self.tails
+            if "rounds_per_sec" in t.last_health
+        ]
+        return min(rates) if rates else None
+
+    def alerts(self) -> list[dict[str, str]]:
+        """Evaluate the threshold alert rules; deterministic given the
+        merged event prefix (the stall rule alone reads the pacing clock)."""
+        out: list[dict[str, str]] = []
+        down = [t.url for t in self.tails if t.down and not t.closed]
+        if down:
+            out.append(
+                {"rule": "stream_down", "detail": ", ".join(sorted(down))}
+            )
+        slo = self.slo
+        if (
+            slo.round_stall_s is not None
+            and self.max_round >= 0
+            and not self.finalized
+            and self.round_stall_s() > slo.round_stall_s
+        ):
+            out.append(
+                {
+                    "rule": "round_stall",
+                    "detail": f"round {self.max_round} for "
+                    f"{self.round_stall_s():.0f}s (SLO {slo.round_stall_s:.0f}s)",
+                }
+            )
+        if (
+            slo.min_quorum_margin is not None
+            and self.min_quorum_margin is not None
+            and self.min_quorum_margin < slo.min_quorum_margin
+        ):
+            out.append(
+                {
+                    "rule": "quorum_margin_low",
+                    "detail": f"min deliver margin {self.min_quorum_margin} "
+                    f"< {slo.min_quorum_margin}",
+                }
+            )
+        anomalies = sum(self.anomalies_by_kind.values())
+        rounds = max(1, self.max_round + 1)
+        if (
+            slo.max_anomalies_per_round is not None
+            and anomalies / rounds > slo.max_anomalies_per_round
+        ):
+            out.append(
+                {
+                    "rule": "anomaly_rate_high",
+                    "detail": f"{anomalies} anomalies over {rounds} rounds",
+                }
+            )
+        if self.auditor.violations:
+            out.append(
+                {
+                    "rule": "audit_violation",
+                    "detail": f"{len(self.auditor.violations)} conformance "
+                    "violations (see audit section)",
+                }
+            )
+        if self.merger.late_events:
+            out.append(
+                {
+                    "rule": "merge_late_events",
+                    "detail": f"{self.merger.late_events} events arrived "
+                    "behind the emission frontier; rolling digest no longer "
+                    "matches the offline merge",
+                }
+            )
+        return out
+
+    def _update_gauges(self) -> None:
+        telemetry.gauge("tower.streams_live").set(
+            sum(1 for t in self.tails if not t.down and not t.closed)
+        )
+        telemetry.gauge("tower.events_merged").set(self.merger.emitted)
+        telemetry.gauge("tower.late_events").set(self.merger.late_events)
+        telemetry.gauge("tower.gap_events").set(
+            sum(t.gap_events for t in self.tails)
+        )
+        telemetry.gauge("tower.round_index").set(self.max_round)
+        telemetry.gauge("tower.suspected_peers").set(len(self.suspected))
+        if self.min_quorum_margin is not None:
+            telemetry.gauge("tower.min_quorum_margin").set(
+                self.min_quorum_margin
+            )
+        telemetry.gauge("tower.audit_violations").set(
+            len(self.auditor.violations)
+        )
+        telemetry.gauge("tower.alerts_active").set(len(self.alerts()))
+
+    # ---- reporting -----------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-ready tower state (the ``--json`` / archive shape)."""
+        frontier = self.merger.frontier
+        return {
+            "polls": self.polls,
+            "finalized": self.finalized,
+            "streams": [
+                {
+                    "url": t.url,
+                    "state": t.state(),
+                    "cursor": t.cursor,
+                    "events_ingested": t.events_ingested,
+                    "gap_events": t.gap_events,
+                    "errors": t.errors,
+                    "health": t.last_health,
+                }
+                for t in self.tails
+            ],
+            "merge": {
+                "emitted": self.merger.emitted,
+                "late_events": self.merger.late_events,
+                "frontier": frontier,
+                "causal_digest": self.merger.digest(),
+            },
+            "audit": {
+                **self.auditor.summary(),
+                "details": [v.to_dict() for v in self.auditor.violations],
+            },
+            "health": {
+                "round_index": self.max_round,
+                "committee": self.committee,
+                "live": self.live,
+                "suspected": list(self.suspected),
+                "min_quorum_margin": self.min_quorum_margin,
+                "anomalies_by_kind": dict(
+                    sorted(self.anomalies_by_kind.items())
+                ),
+                "rounds_per_sec": self.rounds_per_sec(),
+            },
+            "alerts": self.alerts(),
+        }
+
+    def render_dashboard(self) -> str:
+        """Fixed-width text dashboard (the default ``cli tower`` surface)."""
+        snap = self.snapshot()
+        live = sum(1 for s in snap["streams"] if s["state"] == "tailing")
+        lines = [
+            f"p2pdl control tower — {len(self.tails)} streams "
+            f"({live} tailing), poll #{snap['polls']}"
+            + ("  [final]" if self.finalized else ""),
+            f"  {'stream':<28} {'state':<8} {'cursor':>8} {'events':>8} "
+            f"{'gap':>6} {'errs':>5}",
+        ]
+        for s in snap["streams"]:
+            lines.append(
+                f"  {s['url'][:28]:<28} {s['state']:<8} {s['cursor']:>8} "
+                f"{s['events_ingested']:>8} {s['gap_events']:>6} "
+                f"{s['errors']:>5}"
+            )
+        m = snap["merge"]
+        lines.append(
+            f"  merge   emitted={m['emitted']} late={m['late_events']} "
+            f"frontier={m['frontier']} digest={m['causal_digest'][:16]}…"
+        )
+        h = snap["health"]
+        rps = h["rounds_per_sec"]
+        rps_str = f"{rps:.2f}" if rps is not None else "-"
+        lines.append(
+            f"  health  round={h['round_index']} committee={h['committee']} "
+            f"live={h['live']} suspected={h['suspected']} "
+            f"min_margin={h['min_quorum_margin']} rps={rps_str}"
+        )
+        a = snap["audit"]
+        lines.append(
+            f"  audit   violations={a['violations']} "
+            f"by_invariant={a['by_invariant']}"
+        )
+        if snap["alerts"]:
+            for alert in snap["alerts"]:
+                lines.append(f"  ALERT   {alert['rule']}: {alert['detail']}")
+        else:
+            lines.append("  alerts  none")
+        return "\n".join(lines)
+
+
+def _http_json(url: str, timeout: float) -> Any:
+    """GET ``url``; JSON-decode ``application/json`` bodies, return text
+    otherwise (the ``/metrics`` exposition)."""
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        body = resp.read().decode()
+        ctype = resp.headers.get("Content-Type", "")
+    if "json" in ctype:
+        return json.loads(body)
+    return body
+
+
+# ---- Divergence forensics ----------------------------------------------------
+
+
+def load_jsonl(path: str) -> list[dict[str, Any]]:
+    """Load one JSONL stream (flight dump, tower archive, or RoundRecord
+    log); blank lines are skipped, malformed lines raise."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def stream_kind(events: list[dict[str, Any]]) -> str:
+    """``"flight"`` when the stream carries flight events (``kind`` field),
+    ``"records"`` for RoundRecord JSONL (``round`` + loss fields)."""
+    for ev in events:
+        if "kind" in ev:
+            return "flight"
+    return "records"
+
+
+# RoundRecord fields that are timing, not replayed state — the same set
+# tests strip before bit-identity comparisons.
+_RECORD_TIME_FIELDS = ("duration_s",)
+_RECORD_TIME_HEALTH = ("brb_latency_s",)
+
+
+def _strip(ev: dict[str, Any], kind: str) -> dict[str, Any]:
+    out = {k: v for k, v in ev.items() if k != "ts"}
+    if kind == "records":
+        for f in _RECORD_TIME_FIELDS:
+            out.pop(f, None)
+        health = out.get("protocol_health")
+        if isinstance(health, dict):
+            out["protocol_health"] = {
+                k: v
+                for k, v in health.items()
+                if k not in _RECORD_TIME_HEALTH
+            }
+    return out
+
+
+def field_diff(
+    a: dict[str, Any], b: dict[str, Any], kind: str = "flight"
+) -> dict[str, dict[str, Any]]:
+    """Field-level diff of two aligned events: ``{field: {"a":…, "b":…}}``
+    over the union of keys, time fields excluded."""
+    sa, sb = _strip(a, kind), _strip(b, kind)
+    out: dict[str, dict[str, Any]] = {}
+    for key in sorted(set(sa) | set(sb)):
+        va, vb = sa.get(key, "<absent>"), sb.get(key, "<absent>")
+        if va != vb:
+            out[key] = {"a": va, "b": vb}
+    return out
+
+
+def _aligned(events: list[dict[str, Any]], kind: str) -> list[dict[str, Any]]:
+    if kind == "flight":
+        return sorted(events, key=lambda ev: merge_key(ev, 0))
+    return sorted(events, key=lambda ev: int(ev.get("round", -1)))
+
+
+def _cause_index(
+    events: list[dict[str, Any]],
+) -> dict[str, dict[str, Any]]:
+    """Map ``"peer:lamport"`` trace tags to the first event recorded by
+    that peer at that Lamport time — the emission a ``cause`` field names."""
+    index: dict[str, dict[str, Any]] = {}
+    for ev in events:
+        peer, lamport = ev.get("peer"), ev.get("lamport")
+        if peer is None or lamport is None:
+            continue
+        index.setdefault(f"{peer}:{lamport}", ev)
+    return index
+
+
+def blame_chain(
+    a_events: list[dict[str, Any]],
+    b_events: list[dict[str, Any]],
+    a_ev: dict[str, Any],
+    b_ev: dict[str, Any],
+) -> list[dict[str, Any]]:
+    """Walk ``cause`` edges backwards from a divergent event pair to the
+    earliest upstream emission that already differs.
+
+    Returns the chain earliest-cause-first; the divergent pair itself is
+    always the last entry, so the chain is never empty. The walk stops when
+    an event has no ``cause``, the cause resolves identically in both
+    streams (the divergence started at the current link), or a cycle/missing
+    tag breaks the edge.
+    """
+    index_a, index_b = _cause_index(a_events), _cause_index(b_events)
+    chain: list[dict[str, Any]] = []
+    seen: set[str] = set()
+    cur_a, cur_b = a_ev, b_ev
+    while True:
+        chain.append(
+            {
+                "a": _strip(cur_a, "flight"),
+                "b": _strip(cur_b, "flight"),
+                "diff": field_diff(cur_a, cur_b),
+            }
+        )
+        ca, cb = cur_a.get("cause"), cur_b.get("cause")
+        if ca is None or cb is None:
+            break
+        # Follow each stream's own edge (the tags may themselves disagree —
+        # that disagreement is part of the divergence being explained).
+        tag = f"{ca}|{cb}"
+        if tag in seen:
+            break
+        seen.add(tag)
+        nxt_a, nxt_b = index_a.get(str(ca)), index_b.get(str(cb))
+        if nxt_a is None or nxt_b is None:
+            break
+        if _strip(nxt_a, "flight") == _strip(nxt_b, "flight"):
+            break  # upstream agrees: the current link is the blame root
+        cur_a, cur_b = nxt_a, nxt_b
+    chain.reverse()
+    return chain
+
+
+def diverge(
+    a_events: list[dict[str, Any]], b_events: list[dict[str, Any]]
+) -> dict[str, Any]:
+    """First-divergence report between two recorded streams.
+
+    Aligns both by the canonical causal key (flight streams:
+    ``(round, lamport, stream, n)``; RoundRecord logs: round index),
+    compares time-stripped events pairwise, and reports the first
+    divergent position with a field diff plus — for flight streams — the
+    causal blame chain. ``{"identical": True, …}`` when nothing differs.
+    """
+    kind = stream_kind(a_events) if a_events else stream_kind(b_events)
+    a_sorted, b_sorted = _aligned(a_events, kind), _aligned(b_events, kind)
+    n = min(len(a_sorted), len(b_sorted))
+    for i in range(n):
+        ea, eb = a_sorted[i], b_sorted[i]
+        if _strip(ea, kind) == _strip(eb, kind):
+            continue
+        report: dict[str, Any] = {
+            "identical": False,
+            "kind": kind,
+            "index": i,
+            "a_len": len(a_sorted),
+            "b_len": len(b_sorted),
+            "first_divergent": {
+                "a": _strip(ea, kind),
+                "b": _strip(eb, kind),
+                "diff": field_diff(ea, eb, kind),
+            },
+        }
+        if kind == "flight":
+            report["blame_chain"] = blame_chain(a_sorted, b_sorted, ea, eb)
+        return report
+    if len(a_sorted) != len(b_sorted):
+        longer, which = (a_sorted, "a") if len(a_sorted) > n else (b_sorted, "b")
+        return {
+            "identical": False,
+            "kind": kind,
+            "index": n,
+            "a_len": len(a_sorted),
+            "b_len": len(b_sorted),
+            "first_divergent": {
+                "only_in": which,
+                which: _strip(longer[n], kind),
+                "diff": {},
+            },
+            **({"blame_chain": []} if kind == "flight" else {}),
+        }
+    return {
+        "identical": True,
+        "kind": kind,
+        "a_len": len(a_sorted),
+        "b_len": len(b_sorted),
+    }

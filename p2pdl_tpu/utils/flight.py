@@ -39,12 +39,13 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Any, Optional
+from typing import Any, Iterable, Optional
 
 __all__ = [
     "FlightRecorder",
     "DEFAULT_CAPACITY",
     "ANOMALY_KINDS",
+    "KNOWN_KINDS",
     "recorder",
     "record",
     "anomaly",
@@ -67,6 +68,39 @@ ANOMALY_KINDS = (
     "brb_timeout",
     "batch_rejected",
     "quorum_collapse",
+    "recompile",
+    "audit_violation",
+)
+
+# Every event kind the codebase records, in protocol-plane order. This is
+# the validation universe for the ``/flight?kind=`` server-side filter: a
+# typo'd filter must fail loudly (400) rather than silently tail nothing.
+# New ``flight.record`` call sites must register their kind here.
+KNOWN_KINDS = (
+    # driver / round lifecycle
+    "round_begin",
+    "quorum_reconfig",
+    "quorum_collapse",
+    "agg_admit",
+    "d2h",
+    "mask_recovery",
+    "pipeline_flush",
+    # cluster membership
+    "membership",
+    # BRB instance lifecycle
+    "brb_init",
+    "brb_send",
+    "brb_echo",
+    "brb_ready",
+    "brb_deliver",
+    "brb_vote",
+    "brb_timeout",
+    "batch_rejected",
+    # failure detector / chaos
+    "suspect",
+    "unsuspect",
+    "fault",
+    # performance + conformance planes
     "recompile",
     "audit_violation",
 )
@@ -167,28 +201,44 @@ class FlightRecorder:
         since: int = 0,
         limit: Optional[int] = None,
         strip_time: bool = False,
+        kinds: Optional[Iterable[str]] = None,
     ) -> dict[str, Any]:
         """Cursor-paged view of the ring for live tailing: events with
-        ``n >= since``, oldest first, at most ``limit`` of them.
+        ``n >= since``, oldest first, at most ``limit`` of them, optionally
+        restricted to the given ``kinds``.
 
-        Returns ``{"events", "next_cursor", "events_recorded"}`` —
-        ``next_cursor`` is the ``since`` that continues the tail (one past
-        the last returned event, or the current sequence head when the
-        page is empty), and ``events_recorded`` lets the caller detect a
-        cursor that fell off the ring (missed history)."""
+        Returns ``{"events", "next_cursor", "events_recorded",
+        "oldest_retained"}`` — ``next_cursor`` is the ``since`` that
+        continues the tail (one past the last *scanned* event, or the
+        current sequence head when the page is empty), ``events_recorded``
+        is the monotone sequence head, and ``oldest_retained`` is the
+        smallest ``n`` still in the ring (None when empty), so a tailer can
+        compute exactly how much history its cursor lost to ring eviction:
+        ``max(0, oldest_retained - cursor)``. With a ``kinds`` filter the
+        cursor still advances past non-matching events (they are scanned,
+        not returned), so a sparse filter cannot stall the tail."""
+        kindset = frozenset(kinds) if kinds is not None else None
         with self._lock:
-            evs = [dict(ev) for ev in self._ring if ev["n"] >= since]
+            scanned = [ev for ev in self._ring if ev["n"] >= since]
             head = self._seq
-        if limit is not None:
-            evs = evs[: max(0, limit)]
+            oldest = self._ring[0]["n"] if self._ring else None
+        evs: list[dict[str, Any]] = []
+        last_scanned = None
+        for ev in scanned:
+            if limit is not None and len(evs) >= max(0, limit):
+                break
+            last_scanned = ev["n"]
+            if kindset is None or ev["kind"] in kindset:
+                evs.append(dict(ev))
         if strip_time:
             for ev in evs:
                 ev.pop("ts", None)
-        next_cursor = (evs[-1]["n"] + 1) if evs else head
+        next_cursor = (last_scanned + 1) if last_scanned is not None else head
         return {
             "events": evs,
             "next_cursor": next_cursor,
             "events_recorded": head,
+            "oldest_retained": oldest,
         }
 
     def instance_timelines(self) -> dict[str, list[dict[str, Any]]]:

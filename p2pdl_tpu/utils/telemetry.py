@@ -63,6 +63,7 @@ __all__ = [
     "series_key",
     "parse_series_key",
     "render_prometheus",
+    "parse_prometheus_text",
 ]
 
 
@@ -647,6 +648,35 @@ def render_prometheus(snap: Optional[dict[str, dict[str, Any]]] = None) -> str:
             lines.append(f"{pname}_sum{lstr} {hist['sum']}")
             lines.append(f"{pname}_count{lstr} {hist['count']}")
     return "\n".join(lines) + "\n"
+
+
+def parse_prometheus_text(text: str) -> dict[str, float]:
+    """Parse Prometheus 0.0.4 text exposition into ``{sample: value}``.
+
+    The inverse of ``render_prometheus`` for the tower's ``/metrics``
+    scrapes: keys keep their label block verbatim
+    (``p2pdl_brb_messages_total{dir="tx",kind="send"}``), values are
+    floats. Tolerant by design — comment/HELP lines are skipped and
+    malformed lines dropped rather than raised, because a scrape target
+    mid-restart must degrade to a partial sample set, not kill the tower's
+    poll loop.
+    """
+    samples: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        # Sample grammar: name[{labels}] value — the value is the last
+        # whitespace-separated token; labels may contain spaces inside
+        # quoted values, so split from the right.
+        name, _, value = line.rpartition(" ")
+        if not name:
+            continue
+        try:
+            samples[name] = float(value)
+        except ValueError:
+            continue
+    return samples
 
 
 def traced(name: str, fn, **args: Any):

@@ -165,6 +165,28 @@ def test_merged_causal_digest_is_same_seed_bit_identical(probe):
     assert causal_digest(split) == causal_digest(split_again)
 
 
+def test_streaming_merger_equals_offline_merge(probe):
+    """The tower's incremental merge is the same function as the offline
+    one: any chunking of the probe stream across two "processes" yields
+    the offline merged order and digest, with no late events."""
+    from p2pdl_tpu.protocol.audit import StreamingMerger
+
+    half = len(probe) // 2
+    streams = [probe[:half], probe[half:]]
+    offline = merge_streams(streams)
+    for chunk in (7, 64, len(probe)):
+        m = StreamingMerger(2, hold_rounds=2)
+        out = []
+        for lo in range(0, max(len(s) for s in streams), chunk):
+            for si, evs in enumerate(streams):
+                m.push(si, evs[lo : lo + chunk])
+            out.extend(m.poll())
+        out.extend(m.finalize())
+        assert out == offline
+        assert m.late_events == 0
+        assert m.digest() == causal_digest(offline)
+
+
 def test_merge_streams_orders_receives_after_their_cause(probe):
     merged = merge_streams([probe])
     pos = {ev["n"]: i for i, ev in enumerate(merged)}
@@ -381,7 +403,7 @@ def test_flight_page_limit_is_hard_capped():
 
     params, err = _flight_page_params("since=2&limit=999999")
     assert err is None
-    assert params == {"since": 2, "limit": FLIGHT_PAGE_LIMIT_MAX}
+    assert params == {"since": 2, "limit": FLIGHT_PAGE_LIMIT_MAX, "kinds": None}
 
 
 # -------------------------------------------- report warnings (S2)

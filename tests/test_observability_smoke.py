@@ -647,6 +647,63 @@ def test_serve_metrics_loopback_while_writing(tmp_path):
         server.server_close()
 
 
+def test_serve_metrics_healthz_kind_filter_and_recorder_isolation():
+    """The tower-facing surface: /healthz mirrors the driver's round gauges,
+    /flight honors ?kind= (400 JSON naming unknown kinds), and a dedicated
+    ``recorder=`` serves its own ring instead of the process-global one."""
+    import threading
+    import urllib.error
+    import urllib.request
+    import urllib.parse
+
+    from p2pdl_tpu.runtime.server import serve_metrics
+    from p2pdl_tpu.utils import telemetry
+    from p2pdl_tpu.utils.flight import FlightRecorder
+
+    reg = telemetry.MetricsRegistry()
+    reg.gauge("driver.round_index").set(7)
+    reg.gauge("driver.rounds_per_sec").set(2.5)
+    rec = FlightRecorder(capacity=64, enabled=True)
+    rec.record("round_begin", round=0, trainers=[0])
+    rec.record("d2h", round=0, nbytes=128)
+    rec.record("round_begin", round=1, trainers=[1])
+    server = serve_metrics(port=0, snapshot_fn=reg.snapshot, recorder=rec)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    def get(path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as resp:
+            return json.loads(resp.read())
+
+    try:
+        health = get("/healthz")
+        assert health["round_index"] == 7
+        assert health["rounds_per_sec"] == 2.5
+        # The dedicated recorder is what /flight serves — not the global.
+        page = get("/flight?since=0")
+        assert [ev["kind"] for ev in page["events"]] == [
+            "round_begin", "d2h", "round_begin",
+        ]
+        assert page["oldest_retained"] == 0
+        only = get("/flight?since=0&kind=round_begin")
+        assert [ev["round"] for ev in only["events"]] == [0, 1]
+        assert only["next_cursor"] == page["next_cursor"]
+        both = get("/flight?kind=" + urllib.parse.quote("round_begin,d2h"))
+        assert len(both["events"]) == 3
+        try:
+            get("/flight?kind=round_begin,bogus,nope")
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            err = json.loads(e.read())["error"]
+            assert "bogus" in err and "nope" in err
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
 def test_orchestrator_handler_json_errors():
     """The orchestrator's handler answers malformed POSTs with 400 JSON and
     unknown routes with 404 JSON (no jax: a stub state duck-types the

@@ -1,0 +1,658 @@
+"""Control-tower + divergence-forensics tests (PR 13).
+
+Covers the streaming causal merge (offline bit-identity at every prefix),
+the live tower tailing N loopback ``serve_metrics`` endpoints replaying
+recorded streams (the ROADMAP item 2 observability acceptance), gap/backoff
+accounting, the ``cli tower`` surface, and the first-divergence forensics
+matrix over the six known-bad audit mutators.
+
+Everything except the tower-attached/detached RoundRecord bit-identity test
+is pure host: the trust-plane probe runs on the host hub and the tower is
+jax-free by construction.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+import threading
+
+import jax
+import pytest
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.cli import main as cli_main
+from p2pdl_tpu.protocol.audit import (
+    StreamingMerger,
+    causal_digest,
+    merge_key,
+    merge_streams,
+)
+from p2pdl_tpu.runtime.server import serve_metrics
+from p2pdl_tpu.runtime.tower import (
+    ControlTower,
+    TowerSLO,
+    blame_chain,
+    diverge,
+    field_diff,
+    load_jsonl,
+)
+from p2pdl_tpu.utils import flight, telemetry
+
+requires_spmd = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="driver needs jax.shard_map (set P2PDL_JAX_COMPAT=1 for the shims)",
+)
+
+
+# ------------------------------------------------------ stream builders
+
+
+def _synthetic_stream(s: int, rounds: int = 6, stop: bool = False):
+    """A hand-built per-process stream with the real key-order hazards:
+    pipeline flushes landing two rounds late and round-less membership."""
+    evs = []
+    n = 0
+
+    def add(kind, **fields):
+        nonlocal n
+        evs.append({"n": n, "kind": kind, **fields})
+        n += 1
+
+    add("membership", peer=s, change="start")
+    for r in range(rounds):
+        add("round_begin", round=r, trainers=[0, 1, 2], suspected=[])
+        add(
+            "brb_send", sender=s, seq=r, peer=s, lamport=r * 10 + s,
+            cause=None, digest="ab" * 32,
+        )
+        add(
+            "brb_deliver", sender=s, seq=r, peer=s, lamport=r * 10 + s + 1,
+            cause=f"{s}:{r * 10 + s}", votes=3, quorum=3, margin=0,
+            digest="ab" * 32,
+        )
+        if r >= 2:
+            add("pipeline_flush", round=r - 2, depth=2)
+    if stop:
+        add("membership", peer=s, change="stop")
+    return evs
+
+
+def _probe_events(round_idx: int = 0):
+    """One honest committee BRB round on the host hub, flight-recorded —
+    the same clean stream the audit tests start from."""
+    from p2pdl_tpu.runtime.driver import _TrustPlane
+
+    prior = flight.enabled()
+    try:
+        flight.set_enabled(True)
+        flight.reset()
+        cfg = Config(num_peers=8, trainers_per_round=3, byzantine_f=1)
+        trainers = [0, 3, 5]
+        plane = _TrustPlane(cfg)
+        digests = {
+            t: hashlib.sha256(b"probe-%d" % t).digest() for t in trainers
+        }
+        flight.record(
+            "round_begin", round=round_idx, trainers=trainers, suspected=[]
+        )
+        plane.run_round(round_idx, trainers, digests)
+        return flight.recorder().events(strip_time=True)
+    finally:
+        flight.reset()
+        flight.set_enabled(prior)
+
+
+@pytest.fixture(scope="module")
+def probe():
+    return _probe_events()
+
+
+def _replay_recorder(events) -> flight.FlightRecorder:
+    """Load a time-stripped event list into a dedicated recorder so a
+    loopback ``serve_metrics`` endpoint replays it over ``/flight``."""
+    rec = flight.FlightRecorder(capacity=8192, enabled=True)
+    for ev in events:
+        ev = dict(ev)
+        ev.pop("n", None)
+        ev.pop("ts", None)
+        kind = ev.pop("kind", "?")
+        if ev.pop("anomaly", False):
+            rec.anomaly(kind, **ev)
+        else:
+            rec.record(kind, **ev)
+    return rec
+
+
+@pytest.fixture()
+def loopback_cluster():
+    """Three loopback serve_metrics endpoints, each replaying a distinct
+    recorded stream from its own recorder (one process, three streams)."""
+    servers = []
+
+    def start(streams):
+        urls = []
+        for evs in streams:
+            srv = serve_metrics(port=0, recorder=_replay_recorder(evs))
+            servers.append(srv)
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            urls.append("http://127.0.0.1:%d" % srv.server_address[1])
+        return urls
+
+    yield start
+    for srv in servers:
+        srv.shutdown()
+
+
+# ------------------------------------------------------ streaming merge
+
+
+def test_streaming_merger_matches_offline_at_every_prefix():
+    streams = [_synthetic_stream(s) for s in range(3)]
+    offline = merge_streams(streams)
+    m = StreamingMerger(3, hold_rounds=2)
+    emitted = []
+    for lo in range(0, max(len(s) for s in streams), 4):
+        for si, evs in enumerate(streams):
+            m.push(si, evs[lo : lo + 4])
+        emitted.extend(m.poll())
+        # Prefix invariant: what has been emitted IS the offline merge of
+        # exactly those events, so the rolling digest matches offline.
+        assert emitted == offline[: len(emitted)]
+        assert m.digest() == causal_digest(emitted)
+    emitted.extend(m.finalize())
+    assert m.late_events == 0
+    assert emitted == offline
+    assert m.digest() == causal_digest(offline)
+
+
+def test_streaming_merger_replay_with_roundless_tail_is_exact():
+    # membership "stop" events carry no round (key round -1); in replay
+    # mode everything is buffered before first emission, so they still
+    # land at their offline-sorted position.
+    streams = [_synthetic_stream(s, stop=True) for s in range(3)]
+    offline = merge_streams(streams)
+    m = StreamingMerger(3, hold_rounds=2)
+    for si, evs in enumerate(streams):
+        m.push(si, evs)
+    out = m.poll() + m.finalize()
+    assert m.late_events == 0
+    assert out == offline
+    assert m.digest() == causal_digest(offline)
+
+
+def test_streaming_merger_counts_late_events_and_still_emits():
+    m = StreamingMerger(2, hold_rounds=0)
+    m.push(0, [{"n": 0, "kind": "round_begin", "round": 5}])
+    m.push(1, [{"n": 0, "kind": "round_begin", "round": 5}])
+    first = m.poll()  # frontier 5: rounds < 5 emit — nothing buffered below
+    assert first == []
+    m.push(0, [{"n": 1, "kind": "round_begin", "round": 6}])
+    m.push(1, [{"n": 1, "kind": "round_begin", "round": 6}])
+    emitted = m.poll()
+    assert [ev["round"] for ev in emitted] == [5, 5]
+    # An event from a round the frontier already passed: late, not lost.
+    m.push(0, [{"n": 2, "kind": "pipeline_flush", "round": 3}])
+    m.push(0, [{"n": 3, "kind": "round_begin", "round": 9}])
+    m.push(1, [{"n": 2, "kind": "round_begin", "round": 9}])
+    emitted = m.poll()
+    assert {ev["round"] for ev in emitted} >= {3}
+    assert m.late_events == 1
+
+
+def test_streaming_merger_frontier_tracks_slowest_live_stream():
+    m = StreamingMerger(2, hold_rounds=0)
+    m.push(0, [{"n": 0, "kind": "round_begin", "round": 7}])
+    assert m.frontier == -2  # silent stream 1 pins the frontier
+    m.push(1, [{"n": 0, "kind": "round_begin", "round": 3}])
+    assert m.frontier == 3
+    m.close(1)
+    assert m.frontier == 7
+    m.close(0)
+    assert m.frontier is None
+
+
+def test_merge_key_is_the_offline_sort_key(probe):
+    keyed = sorted(probe, key=lambda ev: merge_key(ev, 0))
+    assert keyed == merge_streams([probe])
+
+
+# ------------------------------------------------------ live tower e2e
+
+
+def test_tower_digest_matches_offline_cli_audit(
+    probe, loopback_cluster, tmp_path, capsys
+):
+    """ROADMAP item 2 observability acceptance: the tower tailing three
+    loopback endpoints replaying recorded streams produces a causal digest
+    bit-identical to offline ``cli audit`` over the same dumps, clean."""
+    streams = [probe, _probe_events(1), _probe_events(2)]
+    paths = []
+    for i, evs in enumerate(streams):
+        p = tmp_path / f"peer{i}.jsonl"
+        p.write_text(
+            "".join(json.dumps(ev, sort_keys=True) + "\n" for ev in evs)
+        )
+        paths.append(str(p))
+    urls = loopback_cluster(streams)
+
+    tower = ControlTower(urls, poll_interval=0.05)
+    snap = tower.run_to_exhaustion(max_polls=32)
+    assert snap["merge"]["late_events"] == 0
+    assert snap["audit"]["violations"] == 0
+    assert [s["gap_events"] for s in snap["streams"]] == [0, 0, 0]
+
+    args = ["audit", "--json"]
+    for p in paths:
+        args += ["--inputs", p]
+    assert cli_main(args) == 0
+    offline = json.loads(capsys.readouterr().out)
+    assert snap["merge"]["emitted"] == offline["events"]
+    assert snap["merge"]["causal_digest"] == offline["causal_digest"]
+
+
+def test_cli_tower_once_json_and_archive(
+    probe, loopback_cluster, tmp_path, capsys
+):
+    streams = [probe, _probe_events(1)]
+    urls = loopback_cluster(streams)
+    archive = tmp_path / "archive.jsonl"
+    args = ["tower", "--once", "--json", "--archive", str(archive)]
+    for u in urls:
+        args += ["--inputs", u]
+    assert cli_main(args) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["finalized"] is True
+    assert snap["merge"]["emitted"] == sum(len(s) for s in streams)
+    # The archive replays the merged order and is sealed by the digest.
+    lines = [json.loads(l) for l in archive.read_text().splitlines()]
+    trailer = lines[-1]
+    assert trailer["tower_archive"]["causal_digest"] == (
+        snap["merge"]["causal_digest"]
+    )
+    assert trailer["tower_archive"]["emitted"] == len(lines) - 1
+    assert causal_digest(lines[:-1]) == snap["merge"]["causal_digest"]
+
+
+def test_cli_tower_dashboard_renders_text(probe, loopback_cluster, capsys):
+    urls = loopback_cluster([probe])
+    assert cli_main(["tower", "--once", "--inputs", urls[0]]) == 0
+    out = capsys.readouterr().out
+    assert "p2pdl control tower" in out
+    assert "merge" in out and "digest=" in out
+    assert "audit" in out
+
+
+def test_tower_kind_filtered_tail(probe, loopback_cluster):
+    urls = loopback_cluster([probe])
+    tower = ControlTower(urls, poll_interval=0.05, kinds=("brb_deliver",))
+    snap = tower.run_to_exhaustion(max_polls=16)
+    assert snap["merge"]["emitted"] == sum(
+        1 for ev in probe if ev["kind"] == "brb_deliver"
+    )
+    delivers = [ev for ev in probe if ev["kind"] == "brb_deliver"]
+    assert snap["merge"]["causal_digest"] == causal_digest(
+        merge_streams([delivers])
+    )
+
+
+def test_tower_gap_accounting_under_ring_eviction(loopback_cluster):
+    rec = flight.FlightRecorder(capacity=4, enabled=True)
+    srv = serve_metrics(port=0, recorder=rec)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = "http://127.0.0.1:%d" % srv.server_address[1]
+    try:
+        for r in range(4):
+            rec.record("round_begin", round=r, trainers=[0])
+        tower = ControlTower([url], poll_interval=0.05, slo=TowerSLO())
+        tower.poll_once()
+        assert tower.tails[0].cursor == 4
+        assert tower.tails[0].gap_events == 0
+        # 10 more events through a 4-slot ring: exactly 6 fall off before
+        # the next poll can see them.
+        for r in range(4, 14):
+            rec.record("round_begin", round=r, trainers=[0])
+        snap = tower.poll_once()
+        assert snap["streams"][0]["gap_events"] == 6
+        assert tower.tails[0].cursor == 14
+    finally:
+        srv.shutdown()
+
+
+def test_tower_backoff_and_stream_down_alert():
+    # Nothing listens on this port (bound-then-closed to reserve it).
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    tower = ControlTower(
+        [f"http://127.0.0.1:{port}"], poll_interval=0.05, http_timeout=0.2
+    )
+    for _ in range(4):
+        tower.tails[0].next_attempt = 0.0  # bypass the backoff wait
+        tower.poll_once()
+    tail = tower.tails[0]
+    assert tail.errors == 4 and tail.consecutive_errors == 4
+    assert tail.next_attempt > 0.0  # backoff armed
+    assert any(a["rule"] == "stream_down" for a in tower.alerts())
+
+
+def test_tower_counts_into_telemetry_registry(probe, loopback_cluster):
+    urls = loopback_cluster([probe])
+    # Counters are process-global and accumulate across towers: assert the
+    # delta this tower contributes. Gauges are overwritten, so absolutes hold.
+    before = telemetry.snapshot("tower.")["counters"]
+    tower = ControlTower(urls, poll_interval=0.05)
+    tower.run_to_exhaustion(max_polls=16)
+    snap = telemetry.snapshot("tower.")
+    assert snap["counters"]["tower.polls"] > before.get("tower.polls", 0)
+    assert snap["counters"].get("tower.events_ingested", 0) - before.get(
+        "tower.events_ingested", 0
+    ) == len(probe)
+    assert snap["gauges"].get("tower.events_merged") == len(probe)
+    assert snap["gauges"].get("tower.late_events") == 0
+
+
+def test_tower_health_model_from_merged_events(loopback_cluster):
+    evs = []
+    n = 0
+
+    def add(kind, **fields):
+        nonlocal n
+        evs.append({"n": n, "kind": kind, **fields})
+        n += 1
+
+    add("round_begin", round=0, trainers=[0, 1], suspected=[])
+    add("suspect", round=0, peer=5, misses=3)
+    add(
+        "quorum_reconfig", round=1, live=7, committee=8, f=1, suspected=[5]
+    )
+    add(
+        "brb_deliver", sender=0, seq=1, peer=1, lamport=4, cause="0:3",
+        votes=6, quorum=5, margin=1, digest="cd" * 32,
+    )
+    add("unsuspect", round=2, peer=5)
+    add("round_begin", round=3, trainers=[0, 1], suspected=[])
+    urls = loopback_cluster([evs])
+    tower = ControlTower(urls, poll_interval=0.05)
+    snap = tower.run_to_exhaustion(max_polls=16)
+    h = snap["health"]
+    assert h["round_index"] == 3
+    assert h["committee"] == 8 and h["live"] == 7
+    assert h["suspected"] == []  # suspect then unsuspect
+    assert h["min_quorum_margin"] == 1
+    assert snap["audit"]["violations"] == 0
+
+
+def test_tower_slo_alert_rules_fire_deterministically(loopback_cluster):
+    evs = [
+        {"n": 0, "kind": "round_begin", "round": 0, "trainers": [0]},
+        {
+            "n": 1, "kind": "brb_deliver", "sender": 0, "seq": 0, "peer": 0,
+            "lamport": 1, "cause": None, "votes": 3, "quorum": 3,
+            "margin": 0, "digest": "ab" * 32,
+        },
+        {"n": 2, "kind": "brb_timeout", "round": 0, "anomaly": True,
+         "sender": 1, "seq": 0},
+        {"n": 3, "kind": "brb_timeout", "round": 0, "anomaly": True,
+         "sender": 2, "seq": 0},
+    ]
+    urls = loopback_cluster([evs])
+    tower = ControlTower(
+        urls,
+        poll_interval=0.05,
+        slo=TowerSLO(min_quorum_margin=1, max_anomalies_per_round=1.0),
+    )
+    snap = tower.run_to_exhaustion(max_polls=16)
+    rules = {a["rule"] for a in snap["alerts"]}
+    assert "quorum_margin_low" in rules
+    assert "anomaly_rate_high" in rules
+    assert snap["health"]["anomalies_by_kind"] == {"brb_timeout": 2}
+
+
+# ------------------------------------------------------ divergence CLI
+
+
+_MUTATORS = {
+    "conflicting_deliver": lambda evs: [
+        e for e in evs if e["kind"] == "brb_deliver"
+    ][3].update(digest="ff" * 32),
+    "forged_quorum": lambda evs: [
+        e for e in evs if e["kind"] == "brb_deliver"
+    ][0].update(votes=1),
+    "double_vote": lambda evs: evs.append(
+        dict(
+            [e for e in evs if e["kind"] == "brb_vote"][0],
+            n=evs[-1]["n"] + 1,
+        )
+    ),
+    "unregistered_voter": lambda evs: [
+        e for e in evs if e["kind"] == "brb_vote"
+    ][0].update(voter=99),
+    "non_monotone_reconfig": lambda evs: evs.extend(
+        [
+            {
+                "n": evs[-1]["n"] + 1, "kind": "quorum_reconfig",
+                "round": 0, "live": 6, "committee": 8, "f": 1,
+                "suspected": [1, 2],
+            },
+            {
+                "n": evs[-1]["n"] + 2, "kind": "quorum_reconfig",
+                "round": 0, "live": 7, "committee": 8, "f": 1,
+                "suspected": [1, 2, 4],
+            },
+        ]
+    ),
+    "tainted_digest": lambda evs: [
+        e for e in evs if e["kind"] == "agg_admit"
+    ][0].update(digest="ee" * 32),
+}
+
+# The event kind each mutator corrupts in place (None: inserts new events,
+# so the first divergent pair straddles two kinds).
+_MUTATED_KIND = {
+    "conflicting_deliver": ("brb_deliver", "digest"),
+    "forged_quorum": ("brb_deliver", "votes"),
+    "double_vote": (None, None),
+    "unregistered_voter": ("brb_vote", "voter"),
+    "non_monotone_reconfig": (None, None),
+    "tainted_digest": ("agg_admit", "digest"),
+}
+
+
+@pytest.mark.parametrize("invariant", sorted(_MUTATORS))
+def test_cli_divergence_names_first_divergent_event(
+    probe, invariant, tmp_path, capsys
+):
+    good = tmp_path / "good.jsonl"
+    bad = tmp_path / "bad.jsonl"
+    evs = copy.deepcopy(probe)
+    _MUTATORS[invariant](evs)
+    good.write_text(
+        "".join(json.dumps(ev, sort_keys=True) + "\n" for ev in probe)
+    )
+    bad.write_text(
+        "".join(json.dumps(ev, sort_keys=True) + "\n" for ev in evs)
+    )
+    rc = cli_main(
+        ["divergence", "--inputs", str(good), "--inputs", str(bad), "--json"]
+    )
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["identical"] is False
+    first = report["first_divergent"]
+    kind, field = _MUTATED_KIND[invariant]
+    if kind is not None:
+        assert first["b"]["kind"] == kind
+        assert field in first["diff"]
+    assert report["blame_chain"], "blame chain must never be empty"
+    # The chain's last link is the divergent pair itself.
+    assert report["blame_chain"][-1]["a"] == first["a"]
+
+
+def test_cli_divergence_identical_streams_exit_zero(probe, tmp_path, capsys):
+    p = tmp_path / "same.jsonl"
+    p.write_text(
+        "".join(json.dumps(ev, sort_keys=True) + "\n" for ev in probe)
+    )
+    assert cli_main(["divergence", "--inputs", str(p), "--inputs", str(p)]) == 0
+    assert "identical" in capsys.readouterr().out
+
+
+def test_cli_divergence_usage_errors_exit_two(tmp_path, capsys):
+    assert cli_main(["divergence"]) == 2
+    p = tmp_path / "one.jsonl"
+    p.write_text("{}\n")
+    assert cli_main(["divergence", "--inputs", str(p)]) == 2
+    capsys.readouterr()
+
+
+def test_blame_chain_walks_cause_edges_upstream(probe):
+    # Corrupt a send AND an echo it caused (a propagated fault): walking
+    # back from the downstream echo pair must climb the cause edge and
+    # surface the upstream send as the blame root.
+    bad = copy.deepcopy(probe)
+    echo = next(e for e in bad if e["kind"] == "brb_echo" and e.get("cause"))
+    peer_s, lamport_s = echo["cause"].split(":")
+    upstream = next(
+        e
+        for e in bad
+        if str(e.get("peer")) == peer_s and str(e.get("lamport")) == lamport_s
+    )
+    upstream["digest"] = "00" * 32
+    echo["digest"] = "11" * 32
+    a_sorted = sorted(probe, key=lambda ev: merge_key(ev, 0))
+    b_sorted = sorted(bad, key=lambda ev: merge_key(ev, 0))
+    idx = next(i for i, e in enumerate(b_sorted) if e is echo)
+    chain = blame_chain(a_sorted, b_sorted, a_sorted[idx], b_sorted[idx])
+    assert len(chain) >= 2  # walked at least one cause edge upstream
+    assert chain[-1]["b"]["kind"] == "brb_echo"
+    assert chain[0]["b"]["digest"] == "00" * 32  # the upstream blame root
+    assert "digest" in chain[0]["diff"]
+
+
+def test_divergence_round_records_field_diff(tmp_path, capsys):
+    recs = [
+        {
+            "round": r, "trainers": [0, 3], "train_loss": 1.0 - r / 10,
+            "eval_loss": 1.1, "eval_acc": 0.5 + r / 10,
+            "duration_s": 0.5 + r,
+            "protocol_health": {"brb_latency_s": 0.01 * r, "delivered": 3},
+        }
+        for r in range(4)
+    ]
+    other = copy.deepcopy(recs)
+    # Timing fields must NOT count as divergence...
+    for rec in other:
+        rec["duration_s"] += 100.0
+        rec["protocol_health"]["brb_latency_s"] += 5.0
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    a.write_text("".join(json.dumps(r, sort_keys=True) + "\n" for r in recs))
+    b.write_text("".join(json.dumps(r, sort_keys=True) + "\n" for r in other))
+    assert cli_main(["divergence", "--inputs", str(a), "--inputs", str(b)]) == 0
+    capsys.readouterr()
+    # ...but a replayed-state field must.
+    other[2]["train_loss"] = 123.0
+    b.write_text("".join(json.dumps(r, sort_keys=True) + "\n" for r in other))
+    rc = cli_main(
+        ["divergence", "--inputs", str(a), "--inputs", str(b), "--json"]
+    )
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["kind"] == "records"
+    assert report["index"] == 2
+    assert set(report["first_divergent"]["diff"]) == {"train_loss"}
+
+
+def test_field_diff_skips_time_fields():
+    a = {"kind": "d2h", "round": 1, "ts": 1.0, "nbytes": 4}
+    b = {"kind": "d2h", "round": 1, "ts": 9.0, "nbytes": 8}
+    assert field_diff(a, b) == {"nbytes": {"a": 4, "b": 8}}
+
+
+def test_load_jsonl_round_trips(tmp_path):
+    p = tmp_path / "x.jsonl"
+    p.write_text('{"a": 1}\n\n{"b": 2}\n')
+    assert load_jsonl(str(p)) == [{"a": 1}, {"b": 2}]
+
+
+# ------------------------------------- tower-attached record bit-identity
+
+
+@pytest.fixture(scope="module")
+def tower_cfg():
+    # Mirrors test_audit's audit_cfg (and test_chaos's chaos_cfg) so the
+    # compile cache is shared across the module boundary.
+    return Config(
+        num_peers=8,
+        trainers_per_round=3,
+        rounds=4,
+        local_epochs=1,
+        samples_per_peer=32,
+        batch_size=32,
+        lr=0.05,
+        server_lr=1.0,
+        brb_enabled=True,
+        aggregator="secure_fedavg",
+    )
+
+
+def _stripped(records):
+    out = []
+    for rec in records:
+        d = rec.to_dict()
+        d.pop("duration_s")
+        if d.get("protocol_health"):
+            d["protocol_health"] = {
+                k: v
+                for k, v in d["protocol_health"].items()
+                if k != "brb_latency_s"
+            }
+        out.append(d)
+    return out
+
+
+@pytest.mark.chaos
+@requires_spmd
+def test_round_records_bit_identical_with_tower_attached(tower_cfg, mesh8):
+    """The observer effect gate: a live tower tailing the process's own
+    exposition endpoint mid-run must not perturb the RoundRecord stream."""
+    from p2pdl_tpu.runtime.driver import Experiment
+
+    def run(attach_tower):
+        flight.reset()
+        flight.set_enabled(True)
+        server = tower = None
+        try:
+            if attach_tower:
+                server = serve_metrics(port=0)
+                threading.Thread(
+                    target=server.serve_forever, daemon=True
+                ).start()
+                url = "http://127.0.0.1:%d" % server.server_address[1]
+                tower = ControlTower([url], poll_interval=0.05)
+                tower.start()
+            exp = Experiment(tower_cfg, fault_plan="crash_drop_partition")
+            exp.run()
+            if tower is not None:
+                tower.stop()
+                tower.finalize()
+            return _stripped(exp.records)
+        finally:
+            if tower is not None:
+                tower.stop()
+            if server is not None:
+                server.shutdown()
+
+    prior = flight.enabled()
+    try:
+        attached = run(True)
+        detached = run(False)
+    finally:
+        flight.reset()
+        flight.set_enabled(prior)
+    assert attached == detached
