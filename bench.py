@@ -649,6 +649,103 @@ def tower_block() -> dict:
             srv.shutdown()
 
 
+def multihost_tcp_block(num_hosts: int = 3) -> dict:
+    """The bench JSON's ``multihost_tcp`` block: the seeded chaos scenario
+    as ``num_hosts`` real OS processes exchanging lockstep frames over
+    loopback ``AsyncTCPTransport`` connections, with the per-host flight
+    determinism digests checked bit-for-bit against the one-process
+    in-memory mesh run of the same seed.
+
+    ``digest_matches_inmemory`` is the headline flag — the wire-level proof
+    that the async transport plane adds zero nondeterminism to the
+    protocol's observable behavior. ``rounds_per_sec`` is protocol-round
+    throughput (key exchange + BRB broadcast/echo/ready + heartbeats over
+    real sockets), gated by the slowest host. Host-only, jax-free.
+    """
+    import os as _os
+    import subprocess
+    import threading as _threading
+
+    from p2pdl_tpu.runtime.lockstep import ChaosSpec, run_in_memory
+
+    repo = _os.path.dirname(_os.path.abspath(__file__))
+    worker = _os.path.join(repo, "tests", "chaos_tcp_worker.py")
+    spec = ChaosSpec(
+        num_peers=2 * num_hosts, num_hosts=num_hosts, rounds=3, f=1,
+        plan="crash_drop_partition", seed=7,
+    )
+    import socket as _socket
+
+    socks = [_socket.socket() for _ in range(2 * num_hosts)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    tp_ports, obs_ports = ports[:num_hosts], ports[num_hosts:]
+    env = dict(_os.environ)
+    env["PYTHONPATH"] = repo + _os.pathsep + env.get("PYTHONPATH", "")
+    procs = []
+    for h in range(num_hosts):
+        cfg = {
+            "host_id": h, "ports": tp_ports, "obs_port": obs_ports[h],
+            "spec": spec.to_dict(),
+        }
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, worker, json.dumps(cfg)],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True, env=env, cwd=repo,
+            )
+        )
+    watchdog = _threading.Timer(180.0, lambda: [p.kill() for p in procs])
+    watchdog.daemon = True
+    watchdog.start()
+    try:
+        verdicts = []
+        for p in procs:
+            line = p.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    "chaos worker died: " + p.stderr.read()[:300]
+                )
+            verdicts.append(json.loads(line))
+    finally:
+        watchdog.cancel()
+        for p in procs:
+            try:
+                p.stdin.write("\n")
+                p.stdin.flush()
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    verdicts.sort(key=lambda v: v["host"])
+    base = run_in_memory(spec)
+    wall_s = max(v["wall_s"] for v in verdicts)
+    return {
+        "hosts": num_hosts,
+        "peers": spec.num_peers,
+        "rounds": spec.rounds,
+        "plan": "crash_drop_partition",
+        "rounds_per_sec": round(spec.rounds / wall_s, 2) if wall_s else None,
+        "wall_s": round(wall_s, 4),
+        "digest_matches_inmemory": (
+            [v["digest"] for v in verdicts] == base["digests"]
+        ),
+        "records_match_inmemory": (
+            [v["records"] for v in verdicts] == base["records"]
+        ),
+        "backpressure_dropped": sum(
+            v["transport"]["backpressure_dropped"] for v in verdicts
+        ),
+        "frames_sent": sum(v["transport"]["sent"] for v in verdicts),
+    }
+
+
 def aggregator_block() -> dict:
     """The bench JSON's ``aggregators`` block: fused Pallas kernel vs the
     dense XLA Gram path for the ``[T, T]`` pairwise-distance assembly, per
@@ -1591,6 +1688,12 @@ def main() -> None:
         rec["aggregators"] = aggregator_block()
     except Exception as e:  # noqa: BLE001 - headline must still print
         rec["aggregators"] = {"error": str(e)[:300]}
+    # Multi-process chaos-over-TCP bit-identity row (async transport
+    # plane), same degrade contract.
+    try:
+        rec["multihost_tcp"] = multihost_tcp_block()
+    except Exception as e:  # noqa: BLE001 - headline must still print
+        rec["multihost_tcp"] = {"error": str(e)[:300]}
     # Probe forensics ride the SUCCESS tail too (not just unreachable
     # records): a CPU-fallback headline carries the accelerator attempts
     # it fell back from (re-exec'd in via P2PDL_BENCH_PROBE_DIAGNOSTICS),

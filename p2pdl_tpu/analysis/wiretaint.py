@@ -5,7 +5,9 @@ attacker-controlled bytes must be shape-validated and signature-verified
 before they touch protocol state, allocation sizes, or parsers.
 
 - **Sources** — the functions where bytes leave the attacker's hands:
-  ``recv_frame`` / ``_recv_exact`` (raw socket reads), the control-plane
+  ``recv_frame`` / ``_recv_exact`` (raw socket reads), their async-plane
+  twins ``recv_frame_async`` / ``readexactly`` (StreamReader frames on the
+  pooled event-loop transport), the control-plane
   parsers ``control_from_wire`` / ``brb_from_wire`` / ``batch_from_wire``
   (their *outputs* are attacker-shaped objects), and HTTP request bodies
   (``self.rfile.read``) in the orchestrator.
@@ -46,6 +48,8 @@ RULE_NAME = "wire-taint"
 _SOURCES = frozenset(
     {
         "recv_frame",
+        "recv_frame_async",
+        "readexactly",
         "control_from_wire",
         "brb_from_wire",
         "batch_from_wire",
@@ -55,7 +59,10 @@ _SOURCES = frozenset(
 )
 _SANITIZERS = frozenset({"verify", "crypto_ok", "batch_ok", "sign_ok", "has_key"})
 _SIZED_READS = frozenset(
-    {"read", "recv", "recvfrom", "recv_exact", "_recv_exact", "read_exact"}
+    {
+        "read", "recv", "recvfrom", "recv_exact", "_recv_exact",
+        "read_exact", "readexactly",
+    }
 )
 _SIZED_ALLOCS = frozenset({"bytearray", "range"})
 

@@ -1,0 +1,531 @@
+"""Async control-plane transport: one event loop, pooled framed TCP.
+
+The thread-per-connection ``TCPTransport`` keeps the reference's discipline
+(fresh socket per frame, one listener thread) — fine for the small, rare
+control messages of a simulated mesh, but wrong for the process-per-peer
+deployment the paper implies: every send pays a connect round-trip, a slow
+peer blocks its sender thread, and nothing bounds what a partitioned peer
+can queue. This module is the production shape:
+
+- **Single event loop** in a dedicated thread; every connection is a
+  coroutine on it. ``send()`` stays thread-safe and non-blocking for the
+  protocol threads that call it.
+- **Connection pooling with lazy dial**: the first frame to a peer dials;
+  the connection is kept and reused. Dial failures back off exponentially
+  with deterministic SHA-256 jitter (same keying idiom as the legacy
+  sender), and a peer that stays unreachable trips a fail-fast "down
+  window" so one dead peer cannot stall its queue at dial timeout per
+  frame.
+- **Bounded backpressure**: one send queue per peer with a high-water
+  mark. Beyond it the *newest* frame is dropped and counted
+  (``transport.backpressure_dropped``) — the protocol's retry/quorum
+  machinery owns recovery, the transport just refuses to buffer without
+  bound.
+- **Wire compatibility**: frames are exactly the v1/v2/v3 bytes —
+  4-byte BE length, then 4-byte BE source id + payload. A legacy
+  ``TCPTransport`` peer can dial us (we read frames until EOF, serving
+  both its one-shot connections and pooled ones) and we can dial it (its
+  one-frame-then-close serve loop EOFs our pooled connection; the reader
+  task notices and the next frame re-dials).
+- **Fault injection at the frame boundary**: an optional ``fault_filter``
+  decides, per outgoing frame, how many copies actually hit the wire
+  (0 = dropped by the chaos plane) — the hook `FaultInjector` drives so a
+  seeded FaultPlan drops/duplicates frames on *real* connections.
+  ``set_blocked()`` is the partition face: sends to blocked peers are
+  refused, frames from them discarded, and their pooled connections torn
+  down.
+- **Graceful drain-on-stop**: ``stop()`` waits (bounded) for the queues to
+  flush, then closes every connection, stops the loop, and joins the
+  thread. Idempotent.
+
+Determinism note: this plane is wall-clock-scheduled (dial backoff, drain
+timeouts) and so is *not* itself replayed state. The bit-identity story
+lives one layer up — ``runtime/lockstep.py`` sequences frame delivery into
+deterministic epochs over this transport; the digests cover protocol
+events, never transport timing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import hashlib
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from p2pdl_tpu.protocol.transport import _LEN, MAX_FRAME
+from p2pdl_tpu.utils import telemetry
+
+Handler = Callable[[int, bytes], None]  # (src_id, data) -> None
+
+__all__ = [
+    "AsyncTCPTransport",
+    "recv_frame_async",
+    "send_frame_async",
+    "DEFAULT_HIGH_WATER",
+]
+
+DEFAULT_HIGH_WATER = 512
+
+
+async def recv_frame_async(reader: asyncio.StreamReader) -> Optional[bytes]:
+    """Read one length-prefixed frame; None on EOF/reset/oversize.
+
+    The oversize contract matches :func:`transport.recv_frame`: a length
+    beyond ``MAX_FRAME`` means the stream is unframeable garbage, the
+    event is counted under the rejected series, and the caller must close
+    the connection (the bytes that follow cannot be resynchronized).
+    """
+    try:
+        header = await reader.readexactly(_LEN.size)
+    except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        return None
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        telemetry.counter(
+            "transport.messages", transport="aio", event="rejected"
+        ).inc()
+        return None
+    try:
+        return await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        return None
+
+
+async def send_frame_async(writer: asyncio.StreamWriter, data: bytes) -> None:
+    """Length-prefixed send + drain (the flow-control point)."""
+    writer.write(_LEN.pack(len(data)) + data)
+    await writer.drain()
+
+
+class AsyncTCPTransport:
+    """Pooled single-event-loop framed-TCP transport (see module docstring).
+
+    Thread contract: ``send`` / ``add_peer`` / ``set_blocked`` /
+    ``transport_stats`` / ``stop`` are thread-safe and callable from any
+    protocol thread; everything touching sockets runs on the loop thread.
+    ``handler`` is invoked on the loop thread and must not block — hand
+    off to a queue/condition if the work is heavy.
+    """
+
+    def __init__(
+        self,
+        my_id: int,
+        host: str,
+        port: int,
+        handler: Handler,
+        high_water: int = DEFAULT_HIGH_WATER,
+        dial_retries: int = 2,
+        dial_backoff_s: float = 0.05,
+        dial_timeout_s: float = 5.0,
+        drain_timeout_s: float = 5.0,
+        fault_filter: Optional[Callable[[int, bytes], int]] = None,
+    ) -> None:
+        if high_water < 1:
+            raise ValueError("high_water must be >= 1")
+        self.my_id = my_id
+        self.host = host
+        self.port = port
+        self.handler = handler
+        self.high_water = high_water
+        self.dial_retries = dial_retries
+        self.dial_backoff_s = dial_backoff_s
+        self.dial_timeout_s = dial_timeout_s
+        self.drain_timeout_s = drain_timeout_s
+        self.fault_filter = fault_filter
+        self.peers: dict[int, tuple[str, int]] = {}
+        self._lock = threading.Lock()
+        self._queues: dict[int, collections.deque[bytes]] = {}
+        self._blocked: frozenset[int] = frozenset()
+        self._stopped = False
+        self._started = False
+        # Loop-thread-only state (never touched off-loop after start()).
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._writers: dict[int, asyncio.StreamWriter] = {}
+        self._wake_events: dict[int, asyncio.Event] = {}
+        self._workers: dict[int, asyncio.Task] = {}
+        self._conn_tasks: set[asyncio.Task] = set()
+        self._down_until: dict[int, float] = {}
+        self._down_streak: dict[int, int] = {}
+        # Stats (always written under self._lock) — the /healthz source.
+        self._open = 0
+        self._dialed = 0
+        self._accepted = 0
+        self._retries = 0
+        self._sent = 0
+        self._delivered = 0
+        self._send_failed = 0
+        self._backpressure_dropped = 0
+        self._partition_refused = 0
+        self._fault_dropped = 0
+        self._inflight = 0
+        self._c_sent = telemetry.counter("transport.messages", transport="aio", event="sent")
+        self._c_bytes = telemetry.counter("transport.bytes", transport="aio", event="sent")
+        self._c_fail = telemetry.counter("transport.messages", transport="aio", event="send_failed")
+        self._c_deliver = telemetry.counter("transport.messages", transport="aio", event="delivered")
+        self._c_bytes_deliver = telemetry.counter("transport.bytes", transport="aio", event="delivered")
+        self._c_reject = telemetry.counter("transport.messages", transport="aio", event="rejected")
+        self._c_retry = telemetry.counter("transport.messages", transport="aio", event="retry")
+        self._c_partition = telemetry.counter("transport.messages", transport="aio", event="partitioned")
+        self._c_fault_drop = telemetry.counter("transport.messages", transport="aio", event="fault_dropped")
+        self._c_dup = telemetry.counter("transport.messages", transport="aio", event="duplicated")
+        self._c_backpressure = telemetry.counter("transport.backpressure_dropped", transport="aio")
+        self._c_dial = telemetry.counter("transport.connections", transport="aio", event="dialed")
+        self._c_accept = telemetry.counter("transport.connections", transport="aio", event="accepted")
+        self._g_open = telemetry.gauge("transport.connections_open", transport="aio")
+
+    # ---- lifecycle ----------------------------------------------------------
+
+    def add_peer(self, peer_id: int, host: str, port: int) -> None:
+        with self._lock:
+            self.peers[peer_id] = (host, port)
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name=f"aio-transport-{self.my_id}",
+            daemon=True,
+        )
+        self._thread.start()
+        fut = asyncio.run_coroutine_threadsafe(self._start_server(), self._loop)
+        fut.result(timeout=10.0)
+
+    async def _start_server(self) -> None:
+        self._server = await asyncio.start_server(
+            self._serve_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]  # resolve port 0
+
+    def stop(self) -> None:
+        """Drain queues (bounded), then tear everything down. Idempotent."""
+        with self._lock:
+            already = self._stopped
+            self._stopped = True
+            started = self._started
+        if already or not started or self._loop is None:
+            return
+        deadline = time.monotonic() + self.drain_timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                pending = sum(len(q) for q in self._queues.values())
+                pending += self._inflight
+            if pending == 0:
+                break
+            time.sleep(0.01)
+        fut = asyncio.run_coroutine_threadsafe(self._shutdown(), self._loop)
+        try:
+            fut.result(timeout=10.0)
+        except Exception:  # noqa: BLE001 - teardown is best-effort, bounded
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        self._loop.close()
+
+    async def _shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for task in sorted(self._workers.values(), key=lambda t: t.get_name()):
+            task.cancel()
+        for task in sorted(self._conn_tasks, key=lambda t: t.get_name()):
+            task.cancel()
+        for peer in sorted(self._writers):
+            self._close_writer(self._writers[peer])
+        self._writers.clear()
+        await asyncio.sleep(0)  # let cancellations propagate
+
+    @staticmethod
+    def _close_writer(writer: asyncio.StreamWriter) -> None:
+        try:
+            writer.close()
+        except Exception:  # noqa: BLE001 - teardown must not raise
+            pass
+
+    # ---- server side --------------------------------------------------------
+
+    async def _serve_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        if task is not None:  # track for cancellation at shutdown
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+        with self._lock:
+            self._accepted += 1
+            self._open += 1
+            self._g_open.set(self._open)
+        self._c_accept.inc()
+        try:
+            await self._read_frames(reader)
+        except asyncio.CancelledError:
+            pass  # shutdown: fall through to the close
+        finally:
+            self._close_writer(writer)
+            with self._lock:
+                self._open -= 1
+                self._g_open.set(self._open)
+
+    async def _read_frames(self, reader: asyncio.StreamReader) -> None:
+        """Deliver frames until EOF — serves both legacy one-shot senders
+        and pooled peers, and doubles as the EOF watch on dialed
+        connections."""
+        while True:
+            frame = await recv_frame_async(reader)
+            if frame is None:
+                return
+            if len(frame) < _LEN.size:
+                self._c_reject.inc()
+                return
+            (src,) = _LEN.unpack(frame[: _LEN.size])
+            with self._lock:
+                if src in self._blocked:
+                    self._partition_refused += 1
+                    cut = True
+                else:
+                    self._delivered += 1
+                    cut = False
+            if cut:
+                self._c_partition.inc()
+                continue
+            self._c_deliver.inc()
+            self._c_bytes_deliver.inc(len(frame) - _LEN.size)
+            self.handler(src, frame[_LEN.size :])
+
+    # ---- client side --------------------------------------------------------
+
+    def send(self, dst: int, data: bytes) -> bool:
+        """Enqueue one frame for ``dst``; never blocks.
+
+        True means accepted into the peer's bounded queue (delivery is
+        asynchronous and may still fail — the protocol's quorum/retry
+        machinery owns that). False means refused here: unknown peer,
+        blocked by a partition, queue at its high-water mark (the frame is
+        dropped-newest and counted), or transport stopped.
+        """
+        loop = self._loop
+        with self._lock:
+            if self._stopped or not self._started or loop is None:
+                return False
+            if dst not in self.peers:
+                self._send_failed += 1
+                refusal = "fail"
+            elif dst in self._blocked:
+                self._partition_refused += 1
+                refusal = "partition"
+            else:
+                q = self._queues.get(dst)
+                if q is None:
+                    q = collections.deque()
+                    self._queues[dst] = q
+                if len(q) >= self.high_water:
+                    self._backpressure_dropped += 1
+                    refusal = "backpressure"
+                else:
+                    q.append(data)
+                    refusal = None
+        if refusal == "fail":
+            self._c_fail.inc()
+            return False
+        if refusal == "partition":
+            self._c_partition.inc()
+            return False
+        if refusal == "backpressure":
+            self._c_backpressure.inc()
+            return False
+        try:
+            loop.call_soon_threadsafe(self._wake, dst)
+        except RuntimeError:  # loop torn down between the check and the call
+            return False
+        return True
+
+    def _wake(self, dst: int) -> None:
+        ev = self._wake_events.get(dst)
+        if ev is None:
+            ev = asyncio.Event()
+            self._wake_events[dst] = ev
+            task = self._loop.create_task(self._peer_worker(dst))
+            task.set_name(f"aio-worker-{self.my_id}-{dst}")
+            self._workers[dst] = task
+        ev.set()
+
+    async def _peer_worker(self, dst: int) -> None:
+        ev = self._wake_events[dst]
+        while True:
+            await ev.wait()
+            ev.clear()
+            while True:
+                with self._lock:
+                    q = self._queues.get(dst)
+                    if not q:
+                        break
+                    data = q.popleft()
+                    self._inflight += 1
+                try:
+                    await self._dispatch(dst, data)
+                finally:
+                    with self._lock:
+                        self._inflight -= 1
+
+    async def _dispatch(self, dst: int, data: bytes) -> None:
+        """Apply the chaos-plane frame fate, then transmit each copy."""
+        copies = 1
+        if self.fault_filter is not None:
+            copies = int(self.fault_filter(dst, data))
+        if copies <= 0:
+            with self._lock:
+                self._fault_dropped += 1
+            self._c_fault_drop.inc()
+            return
+        if copies > 1:
+            self._c_dup.inc(copies - 1)
+        for _ in range(copies):
+            await self._transmit(dst, data)
+
+    async def _transmit(self, dst: int, data: bytes) -> None:
+        frame = _LEN.pack(self.my_id) + data
+        for attempt in range(2):  # one reconnect after a stale pooled writer
+            writer = await self._ensure_conn(dst)
+            if writer is None:
+                with self._lock:
+                    self._send_failed += 1
+                self._c_fail.inc()
+                return
+            try:
+                await send_frame_async(writer, frame)
+                with self._lock:
+                    self._sent += 1
+                self._c_sent.inc()
+                self._c_bytes.inc(len(data))
+                return
+            except (ConnectionError, OSError):
+                self._invalidate(dst)
+                if attempt == 0:
+                    self._c_retry.inc()
+                    with self._lock:
+                        self._retries += 1
+        with self._lock:
+            self._send_failed += 1
+        self._c_fail.inc()
+
+    async def _ensure_conn(self, dst: int) -> Optional[asyncio.StreamWriter]:
+        writer = self._writers.get(dst)
+        if writer is not None and not writer.is_closing():
+            return writer
+        with self._lock:
+            addr = self.peers.get(dst)
+            blocked = dst in self._blocked
+        if addr is None or blocked:
+            return None
+        now = self._loop.time()
+        if now < self._down_until.get(dst, 0.0):
+            return None  # fail fast inside the down window
+        backoff = self.dial_backoff_s
+        for attempt in range(self.dial_retries + 1):
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(addr[0], addr[1]),
+                    timeout=self.dial_timeout_s,
+                )
+                self._writers[dst] = writer
+                self._down_until.pop(dst, None)
+                self._down_streak.pop(dst, None)
+                with self._lock:
+                    self._dialed += 1
+                    self._open += 1
+                    self._g_open.set(self._open)
+                self._c_dial.inc()
+                task = self._loop.create_task(self._watch_conn(dst, reader, writer))
+                task.set_name(f"aio-watch-{self.my_id}-{dst}")
+                self._conn_tasks.add(task)
+                task.add_done_callback(self._conn_tasks.discard)
+                return writer
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                if attempt == self.dial_retries:
+                    break
+                self._c_retry.inc()
+                with self._lock:
+                    self._retries += 1
+                # Deterministic jitter, keyed like the legacy sender: no
+                # global RNG in replay-adjacent code.
+                h = hashlib.sha256(
+                    f"{self.my_id}|{dst}|{attempt}".encode()
+                ).digest()
+                await asyncio.sleep(backoff * (1.0 + h[0] / 255.0 * 0.5))
+                backoff *= 2.0
+        # Unreachable: open the fail-fast window, growing with the streak.
+        streak = self._down_streak.get(dst, 0) + 1
+        self._down_streak[dst] = streak
+        window = min(self.dial_backoff_s * (2.0**streak), 2.0)
+        self._down_until[dst] = self._loop.time() + window
+        return None
+
+    async def _watch_conn(
+        self, dst: int, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        """Reader task on a dialed connection: delivers any frames the peer
+        sends back on it and, crucially, notices EOF (a legacy peer closes
+        after one frame) so the pool entry is invalidated promptly."""
+        try:
+            await self._read_frames(reader)
+        finally:
+            if self._writers.get(dst) is writer:
+                del self._writers[dst]
+            self._close_writer(writer)
+            with self._lock:
+                self._open -= 1
+                self._g_open.set(self._open)
+
+    def _invalidate(self, dst: int) -> None:
+        writer = self._writers.pop(dst, None)
+        if writer is not None:
+            self._close_writer(writer)
+
+    # ---- chaos plane --------------------------------------------------------
+
+    def set_blocked(self, peer_ids) -> None:
+        """Partition face: refuse sends to and frames from ``peer_ids`` and
+        tear down any pooled connections to them — the cut is a real
+        connection close, not a silent filter."""
+        with self._lock:
+            self._blocked = frozenset(peer_ids)
+            blocked = sorted(self._blocked)
+        if self._loop is not None and not self._loop.is_closed():
+            self._loop.call_soon_threadsafe(self._cut_blocked, blocked)
+
+    def _cut_blocked(self, blocked: list[int]) -> None:
+        for peer in blocked:
+            self._invalidate(peer)
+
+    # ---- observability ------------------------------------------------------
+
+    def transport_stats(self) -> dict[str, Any]:
+        """JSON-ready snapshot for the orchestrator's ``/healthz`` transport
+        block. Per-peer queue depths live here (a stats dict), never as
+        telemetry labels — peer ids are unbounded identity values."""
+        with self._lock:
+            return {
+                "transport": "aio",
+                "open_connections": self._open,
+                "dialed": self._dialed,
+                "accepted": self._accepted,
+                "retries": self._retries,
+                "sent": self._sent,
+                "delivered": self._delivered,
+                "send_failed": self._send_failed,
+                "backpressure_dropped": self._backpressure_dropped,
+                "partition_refused": self._partition_refused,
+                "fault_dropped": self._fault_dropped,
+                "high_water": self.high_water,
+                "blocked_peers": sorted(self._blocked),
+                "queue_depth": {
+                    str(p): len(q) for p, q in sorted(self._queues.items())
+                },
+            }

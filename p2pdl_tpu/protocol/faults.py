@@ -511,6 +511,116 @@ class FaultInjector:
             return True
         return False
 
+    # -- frame-boundary fates (real-transport chaos) --------------------
+    def frame_fate(
+        self, round_idx: int, src: int, dst: int, route_seq: int, size: int = 0
+    ) -> dict:
+        """Deterministic fate for the ``route_seq``-th frame ``src -> dst``
+        of ``round_idx``, decided at the transport's frame boundary.
+
+        Unlike the hub hooks (which draw from a *global* per-round counter
+        and are therefore a function of total traffic order), this is keyed
+        purely on ``(seed, round, src, dst, route_seq)`` — routes draw
+        independently, so the schedule is identical whether the frames
+        cross one in-memory mesh or N real TCP processes interleaving
+        arbitrarily. Returns ``{"drop", "copies", "delay_ticks",
+        "corrupt_pos"}``; the caller transmits ``copies`` copies (0 when
+        dropped), holds delayed frames for ``delay_ticks`` delivery
+        epochs, and XOR-flips the byte at ``corrupt_pos`` when not None.
+        Crashed endpoints drop everything, both directions, like the hub
+        path."""
+        if src in self.crashed or dst in self.crashed:
+            self._count("crash_drop")
+            return {"drop": True, "copies": 0, "delay_ticks": 0, "corrupt_pos": None}
+        key = (round_idx, "frame", src, dst, route_seq)
+        if (
+            self.plan.drop_rate > 0.0
+            and self._u(*key, "drop") < self.plan.drop_rate
+        ):
+            self._count("drop")
+            return {"drop": True, "copies": 0, "delay_ticks": 0, "corrupt_pos": None}
+        copies = 1
+        if (
+            self.plan.duplicate_rate > 0.0
+            and self._u(*key, "dup") < self.plan.duplicate_rate
+        ):
+            self._count("duplicate")
+            copies = 2
+        delay_ticks = 0
+        if (
+            self.plan.delay_rate > 0.0
+            and self._u(*key, "delay") < self.plan.delay_rate
+        ):
+            self._count("delay")
+            delay_ticks = min(
+                1 + int(self._u(*key, "dticks") * self.plan.max_delay_ticks),
+                self.plan.max_delay_ticks,
+            )
+        corrupt_pos = None
+        if (
+            self.plan.corrupt_rate > 0.0
+            and size > 0
+            and self._u(*key, "corrupt") < self.plan.corrupt_rate
+        ):
+            self._count("corrupt")
+            corrupt_pos = int(self._u(*key, "cpos") * size)
+        return {
+            "drop": False,
+            "copies": copies,
+            "delay_ticks": delay_ticks,
+            "corrupt_pos": corrupt_pos,
+        }
+
+    def frame_filter(self, my_id: int):
+        """Build an ``AsyncTCPTransport.fault_filter`` for host ``my_id``:
+        per-destination frame counters feed :meth:`frame_fate`, and the
+        returned copy count (0 = drop) is applied on the *real* connection.
+        Delay/corrupt fates are not applied at this layer — wall-clock
+        delay is nondeterministic by nature; the lockstep runner holds and
+        mutates frames itself where replay-exactness is claimed."""
+        counters: collections.Counter = collections.Counter()
+
+        def fate(dst: int, data: bytes) -> int:
+            seq = counters[dst]
+            counters[dst] += 1
+            f = self.frame_fate(self._round, my_id, dst, seq)
+            return 0 if f["drop"] else f["copies"]
+
+        return fate
+
+    def cut(self, src: int, dst: int) -> bool:
+        """Does the active partition cut ``src -> dst``? (Same semantics as
+        ``InMemoryHub._cut``: only cross-group pairs are cut; peers in no
+        group are unrestricted.)"""
+        if self.partition is None:
+            return False
+        src_g = dst_g = None
+        for i, g in enumerate(self.partition):
+            if src in g:
+                src_g = i
+            if dst in g:
+                dst_g = i
+        return src_g is not None and dst_g is not None and src_g != dst_g
+
+    def partition_peers(self, my_id: int) -> frozenset[int]:
+        """Peers unreachable from ``my_id`` under the active partition — the
+        set a real transport passes to ``set_blocked`` so the cut closes
+        actual connections."""
+        if self.partition is None:
+            return frozenset()
+        mine = None
+        for i, g in enumerate(self.partition):
+            if my_id in g:
+                mine = i
+        if mine is None:
+            return frozenset()
+        return frozenset(
+            p
+            for i, g in enumerate(self.partition)
+            if i != mine
+            for p in g
+        )
+
     # -- heartbeats -----------------------------------------------------
     def heartbeat_ok(self, round_idx: int, peer: int) -> bool:
         """Did ``peer``'s heartbeat land this round? Crashed peers never

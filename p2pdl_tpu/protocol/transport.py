@@ -405,6 +405,10 @@ class TCPTransport:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._sock: Optional[socket.socket] = None
+        # Live connection threads, tracked so stop() can join them: the old
+        # fire-and-forget daemon threads could outlive stop() mid-recv.
+        self._conn_lock = threading.Lock()
+        self._conns: list[tuple[threading.Thread, socket.socket]] = []
         self._c_sent = telemetry.counter("transport.messages", transport="tcp", event="sent")
         self._c_bytes = telemetry.counter("transport.bytes", transport="tcp", event="sent")
         self._c_fail = telemetry.counter("transport.messages", transport="tcp", event="send_failed")
@@ -414,7 +418,8 @@ class TCPTransport:
         self._c_retry = telemetry.counter("transport.messages", transport="tcp", event="retry")
 
     def add_peer(self, peer_id: int, host: str, port: int) -> None:
-        self.peers[peer_id] = (host, port)
+        with self._conn_lock:
+            self.peers[peer_id] = (host, port)
 
     def start(self) -> None:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -434,11 +439,23 @@ class TCPTransport:
                 continue
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+            t = threading.Thread(
+                target=self._serve, args=(conn,),
+                name=f"tcp-serve-{self.my_id}", daemon=True,
+            )
+            with self._conn_lock:
+                self._conns = [
+                    (th, c) for th, c in self._conns if th.is_alive()
+                ]
+                self._conns.append((t, conn))
+            t.start()
 
     def _serve(self, conn: socket.socket) -> None:
         with conn:
-            frame = recv_frame(conn)
+            try:
+                frame = recv_frame(conn)
+            except OSError:
+                return  # connection torn down under us (e.g. stop())
             if frame is None or len(frame) < _LEN.size:
                 if conn.fileno() != -1:  # oversize already counted+closed in recv_frame
                     self._c_reject.inc()  # malformed/truncated frame
@@ -482,8 +499,32 @@ class TCPTransport:
         return False
 
     def stop(self) -> None:
+        """Idempotent shutdown: close the listener, join the accept loop,
+        then force-close and join every live connection thread (bounded) —
+        no thread outlives stop()."""
         self._stop.set()
-        if self._sock is not None:
-            self._sock.close()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=2.0)
+        with self._conn_lock:
+            conns, self._conns = list(self._conns), []
+        deadline = time.monotonic() + 2.0
+        for _, conn in conns:
+            try:
+                # shutdown() (not just close()) is what actually unblocks a
+                # thread parked in recv mid-frame.
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for t, _ in conns:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))

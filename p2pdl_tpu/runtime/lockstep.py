@@ -1,0 +1,611 @@
+"""Lockstep deterministic chaos runner: one seeded scenario, two deployments.
+
+The acceptance story for the async transport plane is *bit-identity*: the
+same seeded chaos scenario (BRB digest broadcasts under crashes, drops,
+partitions, delays, duplicates) must produce the same per-host flight
+streams whether the N logical hosts share one process and an in-memory
+mesh, or run as N real OS processes exchanging frames over loopback TCP
+(``protocol.aio_transport.AsyncTCPTransport``). Everything nondeterministic
+about a real network — arrival interleaving, dial timing, kernel buffering
+— is fenced off by a *tick barrier*:
+
+- frames emitted while processing tick T are delivered at tick
+  ``T + 1 + delay_ticks`` (the fault plan's delay fate becomes a concrete
+  delivery epoch instead of a wall-clock sleep);
+- a host may process tick T only after every host's ``tick_done(T-1)``
+  marker arrived (frames ride the same pooled FIFO connection as the
+  marker, so marker receipt implies frame receipt);
+- each tick's inbox is processed in the canonical order
+  ``(src, dst, route_seq, copy)`` — the only order-dependent state (Lamport
+  clocks, vote arrival, delivery) sees identical sequences everywhere;
+- a round ends at the first tick where no host emitted and no host holds
+  buffered future frames (the distributed form of the in-memory hub's
+  quiescence promotion).
+
+Fault injection happens at the frame boundary through
+``FaultInjector.frame_fate`` — keyed ``(seed, round, src, dst, route_seq)``,
+never by traffic order — so the same ``FaultPlan`` drops/duplicates/delays/
+corrupts the same frames in both deployments. Flight events are recorded
+per host (``flight.using_recorder`` swaps streams in the single-process
+baseline; each worker process owns its recorder in the TCP deployment), so
+per-stream determinism digests and the causally-merged ``causal_digest``
+both compare bit-for-bit.
+
+jax-free on purpose: the module exercises the protocol/transport planes
+only, so chaos acceptance runs anywhere the control plane does.
+"""
+
+from __future__ import annotations
+
+import base64
+import collections
+import dataclasses
+import hashlib
+import json
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from p2pdl_tpu.protocol.brb import BRBConfig, Broadcaster
+from p2pdl_tpu.protocol.faults import (
+    FailureDetector,
+    FaultInjector,
+    FaultPlan,
+    resolve_plan,
+)
+from p2pdl_tpu.utils import flight
+
+__all__ = [
+    "ChaosSpec",
+    "LockstepHost",
+    "TickChannel",
+    "run_in_memory",
+    "run_tcp_host",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSpec:
+    """One chaos scenario, fully determined by its fields (the whole spec
+    crosses process boundaries as JSON so every worker runs the same run)."""
+
+    num_peers: int = 6
+    num_hosts: int = 3
+    rounds: int = 3
+    f: int = 1
+    trainers_per_round: int = 2
+    plan: Any = "crash_drop_partition"
+    seed: int = 0
+    # Flight ring capacity — identical in every deployment, or ring
+    # eviction alone would split the determinism digests.
+    capacity: int = 65536
+
+    def __post_init__(self) -> None:
+        if self.num_peers % self.num_hosts != 0:
+            raise ValueError(
+                f"num_peers ({self.num_peers}) must divide evenly over "
+                f"num_hosts ({self.num_hosts})"
+            )
+
+    @property
+    def peers_per_host(self) -> int:
+        return self.num_peers // self.num_hosts
+
+    def resolved_plan(self) -> FaultPlan:
+        return resolve_plan(
+            self.plan, self.num_peers, self.rounds, f=self.f, seed=self.seed
+        )
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["plan"] = self.resolved_plan().to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChaosSpec":
+        d = dict(d)
+        if isinstance(d.get("plan"), dict):
+            d["plan"] = FaultPlan.from_dict(d["plan"])
+        return cls(**d)
+
+
+def _frame_key(fr: dict) -> tuple[int, int, int, int]:
+    """Canonical within-tick processing order. Total over a tick's inbox:
+    ``route_seq`` is per (src, dst) route and ``copy`` splits duplicates."""
+    return (fr["src"], fr["dst"], fr["q"], fr["c"])
+
+
+class LockstepHost:
+    """One logical host: its peers' BRB broadcasters, its own seeded fault
+    injector and failure detector, and the frame-boundary fate logic.
+
+    Deployment-agnostic: the in-memory runner and the TCP worker both drive
+    the same three calls per round (``begin_round`` / ``handle_frames`` per
+    tick / ``end_round``), so every protocol decision lives here exactly
+    once and cannot drift between deployments.
+    """
+
+    def __init__(self, host_id: int, spec: ChaosSpec, plan: FaultPlan) -> None:
+        from p2pdl_tpu.protocol.crypto import (
+            KeyServer,
+            generate_key_pair,
+            public_key_from_pem,
+            public_key_pem,
+        )
+
+        self.host_id = host_id
+        self.spec = spec
+        self.injector = FaultInjector(plan, spec.num_peers)
+        self.detector = FailureDetector(spec.num_peers, suspicion_threshold=2)
+        ppn = spec.peers_per_host
+        self.local_peers = list(range(host_id * ppn, (host_id + 1) * ppn))
+        self.key_server = KeyServer()
+        self._from_pem = public_key_from_pem
+        self.pems: dict[int, str] = {}
+        self.broadcasters: dict[int, Broadcaster] = {}
+        brb_cfg = BRBConfig(spec.num_peers, spec.f)
+        for pid in self.local_peers:
+            priv, pub = generate_key_pair()
+            self.key_server.register_key(pid, pub)
+            self.pems[pid] = public_key_pem(pub).decode()
+            self.broadcasters[pid] = Broadcaster(
+                brb_cfg, pid, self.key_server, priv
+            )
+        # Per-route frame counters: monotone over the whole run, keying the
+        # route-local fault schedule.
+        self._route_seq: collections.Counter = collections.Counter()
+        self.records: list[dict] = []
+        self._round = -1
+
+    def register_pems(self, pems: dict) -> None:
+        """Fold other hosts' peer pubkeys into the directory (re-registering
+        an identical key is a no-op, so repeated announcements are safe)."""
+        for pid, pem in sorted(pems.items(), key=lambda kv: int(kv[0])):
+            self.key_server.register_key(int(pid), self._from_pem(pem.encode()))
+
+    def peer_host(self, peer: int) -> int:
+        return peer // self.spec.peers_per_host
+
+    # -- deterministic scenario inputs ----------------------------------
+    def trainers_for(self, r: int) -> list[int]:
+        """PRF-ranked trainer sample for round ``r`` — a pure function of
+        (seed, round), identical on every host and deployment."""
+        ranked = sorted(
+            range(self.spec.num_peers),
+            key=lambda p: hashlib.sha256(
+                f"chaos-trainer|{self.spec.seed}|{r}|{p}".encode()
+            ).hexdigest(),
+        )
+        return sorted(ranked[: self.spec.trainers_per_round])
+
+    def _payload(self, r: int, trainer: int) -> bytes:
+        return json.dumps(
+            {"round": r, "trainer": trainer, "seed": self.spec.seed},
+            sort_keys=True, separators=(",", ":"),
+        ).encode()
+
+    # -- frame-boundary fate fan-out ------------------------------------
+    def _fan_out(self, msgs) -> list[dict]:
+        """Route every protocol message to every peer, applying the active
+        partition and the route-keyed frame fates. Returns frame dicts
+        ``{src, dst, q(route_seq), c(copy), d(delay_ticks), w(wire bytes)}``
+        in canonical generation order."""
+        from p2pdl_tpu.protocol.transport import brb_to_wire
+
+        frames: list[dict] = []
+        for msg in msgs:
+            src = msg.from_id
+            wire = brb_to_wire(msg)
+            for dst in range(self.spec.num_peers):
+                if self.injector.cut(src, dst):
+                    self.injector._count("partition_cut")
+                    continue
+                q = self._route_seq[(src, dst)]
+                self._route_seq[(src, dst)] += 1
+                fate = self.injector.frame_fate(
+                    self._round, src, dst, q, size=len(wire)
+                )
+                if fate["drop"]:
+                    continue
+                data = wire
+                if fate["corrupt_pos"] is not None:
+                    flipped = bytearray(data)
+                    flipped[fate["corrupt_pos"] % len(data)] ^= 0xFF
+                    data = bytes(flipped)
+                for c in range(fate["copies"]):
+                    frames.append(
+                        {
+                            "src": src,
+                            "dst": dst,
+                            "q": q,
+                            "c": c,
+                            "d": fate["delay_ticks"],
+                            "w": data,
+                        }
+                    )
+        return frames
+
+    # -- the three per-round entry points -------------------------------
+    def begin_round(self, r: int) -> list[dict]:
+        """Advance fault state, record the round marker, and originate this
+        round's broadcasts for the trainers this host owns."""
+        self._round = r
+        self.injector.begin_round(r)
+        trainers = self.trainers_for(r)
+        flight.record("round_begin", round=r, trainers=trainers)
+        msgs = []
+        for t in trainers:
+            if t in self.broadcasters and t not in self.injector.crashed:
+                msgs.extend(self.broadcasters[t].broadcast(r, self._payload(r, t)))
+        return self._fan_out(msgs)
+
+    def handle_frames(self, frames: list[dict]) -> list[dict]:
+        """Process one tick's inbox (caller passes it canonically sorted);
+        returns the outbound frames the handling produced."""
+        from p2pdl_tpu.protocol.transport import brb_from_wire
+
+        out_msgs = []
+        for fr in frames:
+            dst = fr["dst"]
+            bc = self.broadcasters.get(dst)
+            if bc is None or dst in self.injector.crashed:
+                continue
+            try:
+                msg = brb_from_wire(fr["w"])
+            except Exception:
+                msg = None  # corrupted frame: unparseable, dropped
+            if msg is None:
+                continue
+            out_msgs.extend(bc.handle(msg))
+        return self._fan_out(out_msgs)
+
+    def end_round(self, r: int) -> dict:
+        """Heartbeat/detector fold, per-trainer delivery verdicts for the
+        peers this host owns, and the round record row."""
+        responded = {
+            p
+            for p in range(self.spec.num_peers)
+            if self.injector.heartbeat_ok(r, p)
+        }
+        self.detector.observe(r, responded)
+        trainers = self.trainers_for(r)
+        delivered = {
+            str(t): sum(
+                1
+                for p in self.local_peers
+                if self.broadcasters[p].delivered(t, r) is not None
+            )
+            for t in trainers
+        }
+        rec = {
+            "round": r,
+            "host": self.host_id,
+            "trainers": trainers,
+            "delivered": delivered,
+            "responded": sorted(responded),
+            "suspected": sorted(self.detector.suspected),
+            "faults": dict(sorted(self.injector.round_injected.items())),
+        }
+        self.records.append(rec)
+        for pid in sorted(self.broadcasters):
+            self.broadcasters[pid].prune(r + 1)
+        return rec
+
+
+# ---------------------------------------------------------------- in-memory
+
+def run_in_memory(spec: ChaosSpec) -> dict:
+    """The single-process baseline: N logical hosts over an in-memory mesh,
+    driven host-by-host in lockstep ticks with per-host flight recorders
+    (``flight.using_recorder``). Returns per-host streams, determinism
+    digests, and round records — the reference the TCP deployment must
+    match bit-for-bit."""
+    plan = spec.resolved_plan()
+    hosts = [LockstepHost(h, spec, plan) for h in range(spec.num_hosts)]
+    recorders = [
+        flight.FlightRecorder(capacity=spec.capacity, enabled=True)
+        for _ in range(spec.num_hosts)
+    ]
+    # Key exchange is trivial in-process: one shared directory pass.
+    all_pems: dict[int, str] = {}
+    for host in hosts:
+        all_pems.update(host.pems)
+    for host in hosts:
+        host.register_pems(all_pems)
+
+    buffers: list[dict[int, list[dict]]] = [
+        collections.defaultdict(list) for _ in range(spec.num_hosts)
+    ]
+
+    def route(frames: list[dict], tick: int) -> None:
+        for fr in frames:
+            dst_host = hosts[0].peer_host(fr["dst"])
+            buffers[dst_host][tick + 1 + fr["d"]].append(fr)
+
+    tick = 0
+    for r in range(spec.rounds):
+        emitted = []
+        for hid, host in enumerate(hosts):
+            with flight.using_recorder(recorders[hid]):
+                frames = host.begin_round(r)
+            route(frames, tick)
+            emitted.append(bool(frames))
+        while True:
+            pending = [
+                any(k > tick for k in buffers[h])
+                for h in range(spec.num_hosts)
+            ]
+            if not (any(emitted) or any(pending)):
+                break
+            tick += 1
+            emitted = []
+            for hid, host in enumerate(hosts):
+                todo = sorted(buffers[hid].pop(tick, []), key=_frame_key)
+                with flight.using_recorder(recorders[hid]):
+                    frames = host.handle_frames(todo)
+                route(frames, tick)
+                emitted.append(bool(frames))
+        for hid, host in enumerate(hosts):
+            with flight.using_recorder(recorders[hid]):
+                host.end_round(r)
+        tick += 1
+    return {
+        "streams": [rec.events(strip_time=True) for rec in recorders],
+        "digests": [rec.determinism_digest() for rec in recorders],
+        "records": [host.records for host in hosts],
+    }
+
+
+# ---------------------------------------------------------------- real TCP
+
+class TickChannel:
+    """The lockstep mesh between real host processes, riding the pooled
+    async transport. Three frame kinds, all JSON over the length-prefixed
+    codec: ``keys`` / ``keys_ack`` (directory bootstrap), ``f`` (a protocol
+    frame with its absolute delivery tick), ``tick_done`` (the barrier
+    marker with the emitted/pending flags the stop rule needs).
+
+    Barrier safety leans on the transport's per-peer FIFO: a tick's frames
+    are enqueued before its marker on the same pooled connection, so
+    holding every host's ``tick_done(T)`` implies every tick-T frame is
+    buffered. Markers are retried until the transport accepts them —
+    control must survive the backpressure that protocol frames are allowed
+    to lose."""
+
+    def __init__(
+        self,
+        host_id: int,
+        num_hosts: int,
+        ports: list[int],
+        high_water: int = 512,
+        send_timeout_s: float = 30.0,
+    ) -> None:
+        from p2pdl_tpu.protocol.aio_transport import AsyncTCPTransport
+
+        self.host_id = host_id
+        self.num_hosts = num_hosts
+        self.send_timeout_s = send_timeout_s
+        self._cv = threading.Condition()
+        self._buffers: dict[int, list[dict]] = collections.defaultdict(list)
+        self._done: dict[int, dict[int, tuple[bool, bool]]] = (
+            collections.defaultdict(dict)
+        )
+        self._peer_pems: dict[int, dict] = {}
+        self._acks: set[int] = set()
+        self.lost_sends = 0
+        self.transport = AsyncTCPTransport(
+            host_id, "127.0.0.1", ports[host_id], self._on_frame,
+            high_water=high_water,
+        )
+        self.transport.start()
+        for h in range(num_hosts):
+            if h != host_id:
+                self.transport.add_peer(h, "127.0.0.1", ports[h])
+
+    # -- receive path (transport event loop: enqueue + notify only) -----
+    def _on_frame(self, src: int, data: bytes) -> None:
+        try:
+            obj = json.loads(data)
+        except ValueError:
+            return
+        kind = obj.get("t")
+        with self._cv:
+            if kind == "f":
+                fr = obj["fr"]
+                fr["w"] = base64.b64decode(fr["w"])
+                self._buffers[int(obj["k"])].append(fr)
+            elif kind == "tick_done":
+                self._done[int(obj["tick"])][src] = (
+                    bool(obj["e"]), bool(obj["p"])
+                )
+            elif kind == "keys":
+                self._peer_pems[src] = obj["pems"]
+            elif kind == "keys_ack":
+                self._acks.add(src)
+            self._cv.notify_all()
+
+    def _send_reliable(self, dst: int, payload: bytes) -> None:
+        """Retry a control frame past transient backpressure; the barrier
+        protocol deadlocks if markers are silently lost."""
+        deadline = time.monotonic() + self.send_timeout_s
+        while not self.transport.send(dst, payload):
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"host {self.host_id}: control frame to {dst} refused "
+                    f"for {self.send_timeout_s}s"
+                )
+            time.sleep(0.001)
+
+    # -- key exchange ----------------------------------------------------
+    def exchange_keys(
+        self,
+        pems: dict[int, str],
+        register: Callable[[dict], None],
+        timeout_s: float = 30.0,
+    ) -> None:
+        """Full pubkey directory on every host before any protocol frame:
+        an unverifiable BRB message is silently dropped, which would be a
+        nondeterministic divergence, not a fault. Announce-until-complete,
+        then an ack barrier so *peers'* directories are known-full too."""
+        msg = json.dumps({"t": "keys", "pems": pems}).encode()
+        deadline = time.monotonic() + timeout_s
+        others = [h for h in range(self.num_hosts) if h != self.host_id]
+
+        def directory_full() -> bool:
+            return all(h in self._peer_pems for h in others)
+
+        while time.monotonic() < deadline:
+            for h in others:
+                self._send_reliable(h, msg)
+            with self._cv:
+                self._cv.wait_for(directory_full, timeout=0.2)
+                if directory_full():
+                    break
+        if not directory_full():
+            raise TimeoutError(
+                f"host {self.host_id}: key exchange incomplete after "
+                f"{timeout_s}s"
+            )
+        for h in others:
+            register(self._peer_pems[h])
+        ack = json.dumps({"t": "keys_ack"}).encode()
+
+        def acked() -> bool:
+            return all(h in self._acks for h in others)
+
+        while time.monotonic() < deadline:
+            for h in others:
+                self._send_reliable(h, msg)
+                self._send_reliable(h, ack)
+            with self._cv:
+                self._cv.wait_for(acked, timeout=0.2)
+                if acked():
+                    return
+        raise TimeoutError(
+            f"host {self.host_id}: key-exchange ack barrier incomplete"
+        )
+
+    # -- tick plane ------------------------------------------------------
+    def send_frames(self, frames: list[dict], tick: int) -> None:
+        """Ship one tick's frames: local destinations buffer directly (the
+        in-memory runner's path, bit-identical); remote ones ride the
+        transport and MAY be refused by backpressure — counted, not
+        retried (protocol loss is the protocol's problem, by design)."""
+        for fr in frames:
+            delivery = tick + 1 + fr["d"]
+            dst_host = self._dst_host(fr["dst"])
+            if dst_host == self.host_id:
+                with self._cv:
+                    self._buffers[delivery].append(dict(fr))
+                continue
+            payload = json.dumps(
+                {
+                    "t": "f",
+                    "k": delivery,
+                    "fr": {
+                        "src": fr["src"],
+                        "dst": fr["dst"],
+                        "q": fr["q"],
+                        "c": fr["c"],
+                        "d": fr["d"],
+                        "w": base64.b64encode(fr["w"]).decode(),
+                    },
+                }
+            ).encode()
+            if not self.transport.send(dst_host, payload):
+                self.lost_sends += 1
+
+    def _dst_host(self, peer: int) -> int:
+        return peer // self._peers_per_host
+
+    # set by run_tcp_host once the spec is known
+    _peers_per_host: int = 1
+
+    def barrier(self, tick: int, emitted: bool, pending: bool) -> bool:
+        """Announce this host's tick verdict, wait for everyone's, and
+        return True when the round went globally idle (nobody emitted,
+        nobody holds future frames)."""
+        marker = json.dumps(
+            {"t": "tick_done", "tick": tick, "e": emitted, "p": pending}
+        ).encode()
+        for h in range(self.num_hosts):
+            if h != self.host_id:
+                self._send_reliable(h, marker)
+        deadline = time.monotonic() + self.send_timeout_s
+        with self._cv:
+            self._done[tick][self.host_id] = (emitted, pending)
+
+            def have_all() -> bool:
+                return len(self._done[tick]) == self.num_hosts
+
+            while not have_all():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(
+                        f"host {self.host_id}: tick {tick} barrier timed "
+                        f"out with {len(self._done[tick])}/{self.num_hosts}"
+                    )
+                self._cv.wait(timeout=min(remaining, 0.2))
+            verdicts = [self._done[tick][h] for h in range(self.num_hosts)]
+            del self._done[tick]
+            return not any(e or p for e, p in verdicts)
+
+    def take(self, tick: int) -> list[dict]:
+        with self._cv:
+            return sorted(self._buffers.pop(tick, []), key=_frame_key)
+
+    def has_pending(self, tick: int) -> bool:
+        with self._cv:
+            return any(k > tick for k in self._buffers)
+
+    def stop(self) -> None:
+        self.transport.stop()
+
+
+def run_tcp_host(
+    spec: ChaosSpec,
+    host_id: int,
+    ports: list[int],
+    high_water: int = 512,
+    key_timeout_s: float = 60.0,
+    on_channel: Optional[Callable[["TickChannel"], None]] = None,
+) -> dict:
+    """One host process's whole run: key exchange, ``spec.rounds`` lockstep
+    rounds over real loopback TCP, then the verdict dict (round records +
+    transport stats; the flight stream lives in the process recorder for
+    ``/flight`` to serve). The caller owns recorder setup — typically
+    ``flight.set_recorder(FlightRecorder(capacity=spec.capacity,
+    enabled=True))`` before calling, matching ``run_in_memory``."""
+    plan = spec.resolved_plan()
+    host = LockstepHost(host_id, spec, plan)
+    ch = TickChannel(
+        host_id, spec.num_hosts, ports, high_water=high_water
+    )
+    ch._peers_per_host = spec.peers_per_host
+    if on_channel is not None:
+        on_channel(ch)
+    try:
+        ch.exchange_keys(host.pems, host.register_pems, timeout_s=key_timeout_s)
+        tick = 0
+        for r in range(spec.rounds):
+            frames = host.begin_round(r)
+            ch.send_frames(frames, tick)
+            emitted = bool(frames)
+            while True:
+                if ch.barrier(tick, emitted, ch.has_pending(tick)):
+                    break
+                tick += 1
+                frames = host.handle_frames(ch.take(tick))
+                ch.send_frames(frames, tick)
+                emitted = bool(frames)
+            host.end_round(r)
+            tick += 1
+        stats = ch.transport.transport_stats()
+    finally:
+        ch.stop()
+    return {
+        "host": host_id,
+        "records": host.records,
+        "transport": stats,
+        "lost_sends": ch.lost_sends,
+    }

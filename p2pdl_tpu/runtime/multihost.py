@@ -14,9 +14,11 @@ module is its entry point:
   (``host_local_batch``), exactly the device-put contract
   ``jax.make_array_from_process_local_data`` expects.
 - **Control plane**: the BRB trust plane runs host-side over the framed-TCP
-  transport (``protocol.transport.TCPTransport``) between hosts — signatures
-  and quorum votes never touch the device program (SURVEY §5: control/data
-  plane split the reference lacks).
+  control plane between hosts (the pooled asyncio transport
+  ``protocol.aio_transport.AsyncTCPTransport`` by default, the legacy
+  ``protocol.transport.TCPTransport`` on request — same wire bytes either
+  way) — signatures and quorum votes never touch the device program
+  (SURVEY §5: control/data plane split the reference lacks).
 
 Single-host (or simulation) callers never need this module; the driver uses
 the in-memory hub. ``initialize()`` is a no-op outside a multi-process
@@ -27,10 +29,11 @@ launch, so the same experiment script works in all three deployments
 from __future__ import annotations
 
 import base64
+import collections
 import dataclasses
 import json
 import os
-import queue
+import threading
 import time
 from typing import Optional
 
@@ -182,15 +185,27 @@ def control_plane_transport(
     bind_host: str,
     bind_port: int,
     handler,
+    kind: str = "aio",
 ):
-    """Framed-TCP control-plane endpoint for the BRB trust plane between
-    hosts (the DCN path; simulation uses ``InMemoryHub`` instead). Thin
-    convenience over ``protocol.transport.TCPTransport``: same wire codec as
-    every other control message (length-prefixed JSON, no pickle).
+    """Control-plane endpoint for the BRB trust plane between hosts (the
+    DCN path; simulation uses ``InMemoryHub`` instead). ``kind`` picks the
+    plane: ``"aio"`` is the pooled single-event-loop asyncio transport
+    (``protocol.aio_transport.AsyncTCPTransport`` — lazy dial, re-dial
+    backoff, bounded per-peer send queues); ``"tcp"`` is the legacy
+    thread-per-connection ``protocol.transport.TCPTransport``. Both speak
+    the identical length-prefixed frame codec (no pickle), so they
+    interoperate on the wire and callers never see the difference.
     ``MultiHostTrustPlane`` builds on this."""
-    from p2pdl_tpu.protocol.transport import TCPTransport
+    if kind == "aio":
+        from p2pdl_tpu.protocol.aio_transport import AsyncTCPTransport
 
-    t = TCPTransport(my_peer_id, bind_host, bind_port, handler)
+        t = AsyncTCPTransport(my_peer_id, bind_host, bind_port, handler)
+    elif kind == "tcp":
+        from p2pdl_tpu.protocol.transport import TCPTransport
+
+        t = TCPTransport(my_peer_id, bind_host, bind_port, handler)
+    else:
+        raise ValueError(f"unknown control-plane transport kind: {kind!r}")
     t.start()
     return t
 
@@ -289,9 +304,18 @@ class MultiHostTrustPlane:
     so only the owner can digest them; a host Byzantine toward its own
     peers is outside this trust model — it controls those peers outright).
 
-    Message handling is single-threaded: transport threads only enqueue;
-    ``_pump`` drains on the caller's thread, so broadcaster state needs no
-    locks (SURVEY §5 race-safety stance).
+    Message handling is single-threaded: the transport's receive path only
+    enqueues (under a condition variable it notifies); ``_pump`` drains on
+    the caller's thread, so broadcaster state needs no locks (SURVEY §5
+    race-safety stance). Receipt is event-driven — the pump sleeps on the
+    condition and is woken the instant a frame lands, instead of the old
+    0.05 s ``queue.Queue`` poll tax per frame.
+
+    The control plane defaults to the pooled asyncio transport
+    (``transport="aio"``): one dialed connection per peer host carries
+    every frame, with bounded per-peer send queues and re-dial backoff.
+    ``transport="tcp"`` keeps the legacy connection-per-frame plane; the
+    wire bytes are identical either way.
 
     Every frame a host ACTS ON is authenticated: BRB messages carry their
     per-peer ECDSA signatures inside the Bracha state machine, and the
@@ -310,6 +334,7 @@ class MultiHostTrustPlane:
         mesh,
         host_addrs: list[tuple[str, int]],
         bind_host: str = "127.0.0.1",
+        transport: str = "aio",
     ) -> None:
         from p2pdl_tpu.protocol.brb import BRBConfig, Broadcaster
         from p2pdl_tpu.protocol.crypto import (
@@ -328,13 +353,17 @@ class MultiHostTrustPlane:
         self.local_peers = list(range(sl.start, sl.stop))
         self.key_server = KeyServer()
         self._from_pem = public_key_from_pem
-        self._queue: queue.Queue = queue.Queue()
+        # Event-driven inbox: the transport's receive path appends and
+        # notifies; _pump sleeps on the condition instead of polling.
+        self._rx: collections.deque = collections.deque()
+        self._rx_cv = threading.Condition()
         self.host_addrs = host_addrs
         self.transport = control_plane_transport(
             topo.process_id,
             bind_host,
             host_addrs[topo.process_id][1],
-            lambda src, data: self._queue.put(data),
+            lambda src, data: self._on_frame(data),
+            kind=transport,
         )
         for h, (hh, pp) in enumerate(host_addrs):
             self.transport.add_peer(h, hh, pp)
@@ -367,6 +396,10 @@ class MultiHostTrustPlane:
         # from an earlier round must not clobber current state (stale
         # report displacing a fresh one, stale decision blocking the slot).
         self._active_round: Optional[int] = None
+        # Failure-detector heartbeats ride the same plane: one probe/ack
+        # round-trip per host per round, collected by host_heartbeat().
+        self._hb_round: Optional[int] = None
+        self._hb_acks: set[int] = set()
 
     # -- wire helpers ------------------------------------------------------
     @staticmethod
@@ -398,10 +431,18 @@ class MultiHostTrustPlane:
             return False
         return self.host_keys.verify(int(obj["host"]), sig, self._canonical(obj))
 
+    def _on_frame(self, data: bytes) -> None:
+        """Transport receive hook: enqueue and wake the pump. Called from
+        the transport's event loop (aio) or serve threads (tcp) — it must
+        never block or touch broadcaster state."""
+        with self._rx_cv:
+            self._rx.append(data)
+            self._rx_cv.notify()
+
     def _send_host(self, h: int, obj: dict) -> None:
         data = json.dumps(obj).encode()
         if h == self.topo.process_id:
-            self._queue.put(data)
+            self._on_frame(data)
         else:
             self.transport.send(h, data)
 
@@ -447,6 +488,24 @@ class MultiHostTrustPlane:
                     self._fan_out_brb(out)
         elif kind == "keys_ack":
             self._acks.add(int(obj["host"]))
+        elif kind == "hb":
+            # Liveness probe: answer on the pump thread. Unsigned by design
+            # — heartbeats only feed the failure detector's suspicion table
+            # (liveness), never a trust verdict, and the detector tolerates
+            # spurious "alive" exactly as it tolerates a slow network.
+            h = int(obj.get("host", -1))
+            if 0 <= h < self.topo.num_processes:
+                self._send_host(
+                    h,
+                    {
+                        "t": "hb_ack",
+                        "host": self.topo.process_id,
+                        "round": obj.get("round"),
+                    },
+                )
+        elif kind == "hb_ack":
+            if obj.get("round") == self._hb_round and "host" in obj:
+                self._hb_acks.add(int(obj["host"]))
         elif kind == "report":
             # Unsigned/forged reports are dropped: a spoofed report could
             # fabricate delivery verdicts or digest attestations for peers
@@ -469,16 +528,25 @@ class MultiHostTrustPlane:
                 self._decision = obj
 
     def _pump(self, deadline: float, done) -> bool:
+        """Drain the inbox on the caller's thread until ``done()`` or the
+        deadline. Event-driven: sleeps on the receive condition and is
+        notified per frame, so frames are handled the moment they land
+        (the old ``queue.Queue(timeout=0.05)`` pump paid up to 50 ms of
+        latency per frame and burned wakeups while idle)."""
         while True:
             if done():
                 return True
-            if time.monotonic() >= deadline:
-                return done()
-            try:
-                data = self._queue.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            self._handle(data)
+            batch: list[bytes] = []
+            with self._rx_cv:
+                if not self._rx:
+                    now = time.monotonic()
+                    if now >= deadline:
+                        return done()
+                    self._rx_cv.wait(timeout=deadline - now)
+                while self._rx:
+                    batch.append(self._rx.popleft())
+            for data in batch:
+                self._handle(data)
 
     # -- protocol rounds ---------------------------------------------------
     def exchange_keys(self, timeout_s: float = 30.0) -> None:
@@ -685,6 +753,50 @@ class MultiHostTrustPlane:
             if len(wires) == 1 and base64.b64decode(next(iter(wires))) == expected:
                 verified.append(t)
         return {"failed": failed, "verified": verified}
+
+    def host_heartbeat(
+        self,
+        round_idx: int,
+        timeout_s: float = 2.0,
+        faults=None,
+    ) -> set[int]:
+        """One failure-detector heartbeat round over the control plane.
+
+        Probes every host (``hb``) and collects acks (``hb_ack``) until all
+        hosts answered or the window closes; returns the responded set, the
+        exact shape :class:`protocol.faults.FailureDetector.observe` folds
+        into its suspicion table. Probes are re-sent once per pump slice —
+        the transport is fire-and-forget and a single lost probe must not
+        read as a dead host.
+
+        ``faults`` (a :class:`protocol.faults.FaultInjector` or anything
+        with its ``heartbeat_ok(round, peer)`` face) injects deterministic
+        heartbeat loss on the OBSERVER side, so the same seeded FaultPlan
+        drives membership identically whether the plane is in-memory or N
+        real processes over TCP.
+        """
+        self._hb_round = round_idx
+        self._hb_acks = set()
+        probe = {"t": "hb", "host": self.topo.process_id, "round": round_idx}
+        deadline = time.monotonic() + timeout_s
+        all_acked = lambda: len(self._hb_acks) == self.topo.num_processes  # noqa: E731
+        while time.monotonic() < deadline and not all_acked():
+            self._broadcast_hosts(probe)
+            self._pump(min(time.monotonic() + 0.25, deadline), all_acked)
+        responded = {
+            h
+            for h in sorted(self._hb_acks)
+            if faults is None or faults.heartbeat_ok(round_idx, h)
+        }
+        self._hb_round = None
+        return responded
+
+    def transport_stats(self) -> dict:
+        """The control plane's transport counters (pooled connections,
+        dialed/accepted, backpressure drops, queue depths) for /healthz;
+        the legacy plane reports only its kind."""
+        fn = getattr(self.transport, "transport_stats", None)
+        return fn() if fn is not None else {"transport": "tcp"}
 
     def stop(self) -> None:
         self.transport.stop()

@@ -4,6 +4,11 @@ API parity with the reference's Flask app (reference ``main.py``):
 ``POST /start_training`` runs the configured number of rounds and returns
 the per-round learning progress JSON (reference ``main.py:45-109``);
 ``GET /status`` is the liveness probe (reference ``main.py:112-115``).
+Membership rides the same facade: ``GET /membership`` is the failure
+detector's live view plus the administratively-stopped set, and ``POST
+/join`` / ``POST /leave`` re-admit or stop a KNOWN node (static membership
+— an unknown peer_id is a 400, the cluster never grows past its
+provisioned key/data/mesh footprint).
 Built on ``http.server`` (stdlib) so the framework adds no web-framework
 dependency; single worker thread — the driver is intentionally
 single-threaded (SURVEY §5 race-detection note).
@@ -135,11 +140,57 @@ class OrchestratorState:
                 self.training = False
 
 
+def _label_match(key: str, label: str, value: str) -> bool:
+    """Exact label match inside a ``name{k=v,...}`` series key (substring
+    checks would conflate ``event=sent`` with ``event=send_failed``)."""
+    probe = f"{label}={value}"
+    return f"{{{probe}}}" in key or f"{{{probe}," in key or (
+        f",{probe}," in key or f",{probe}}}" in key
+    )
+
+
+def _transport_health(snap: dict) -> dict:
+    """The /healthz ``transport`` block, derived from the ``transport.*``
+    telemetry series (summed across transports when both planes ran).
+    Per-peer queue depth is NOT here — that would be a per-peer identity
+    label (cardinality lint); live servers with a transport handle pass
+    ``transport_stats`` for the full per-peer view instead."""
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+
+    def total(name: str, event: Optional[str] = None) -> float:
+        out = 0
+        for key, val in sorted(counters.items()):
+            if key != name and not key.startswith(name + "{"):
+                continue
+            if event is not None and not _label_match(key, "event", event):
+                continue
+            out += val
+        return out
+
+    return {
+        "open_connections": sum(
+            v
+            for k, v in sorted(gauges.items())
+            if k.startswith("transport.connections_open")
+        ),
+        "dialed": total("transport.connections", "dialed"),
+        "accepted": total("transport.connections", "accepted"),
+        "retries": total("transport.messages", "retry"),
+        "sent": total("transport.messages", "sent"),
+        "delivered": total("transport.messages", "delivered"),
+        "send_failed": total("transport.messages", "send_failed"),
+        "rejected": total("transport.messages", "rejected"),
+        "backpressure_dropped": total("transport.backpressure_dropped"),
+    }
+
+
 def _observability_get(
     path: str,
     snapshot_fn: Callable[[], dict],
     extra_health: Optional[Callable[[], dict]] = None,
     recorder: Optional[flight.FlightRecorder] = None,
+    transport_stats: Optional[Callable[[], dict]] = None,
 ) -> Optional[tuple[int, str, bytes]]:
     """Route the shared observability GETs; returns ``(status, content_type,
     body)`` or None when ``path`` is not an observability endpoint.
@@ -154,14 +205,22 @@ def _observability_get(
         return 200, PROMETHEUS_CONTENT_TYPE, body
     rec = recorder if recorder is not None else flight.recorder()
     if path == "/healthz":
+        snap = snapshot_fn()
         payload: dict[str, Any] = {
             "status": "ok",
             "anomaly_count": rec.anomaly_count,
             "anomalies_by_kind": dict(sorted(rec.anomalies_by_kind.items())),
+            # A server holding a live transport handle reports the full
+            # per-peer view (queue depths included); otherwise the block is
+            # reconstructed from the transport.* telemetry series.
+            "transport": (
+                transport_stats() if transport_stats is not None
+                else _transport_health(snap)
+            ),
         }
         # Cheap training-progress liveness (no /metrics scrape needed):
         # the driver's round gauges, absent until the first round lands.
-        gauges = snapshot_fn().get("gauges", {})
+        gauges = snap.get("gauges", {})
         for field, series in (
             ("round_index", "driver.round_index"),
             ("rounds_per_sec", "driver.rounds_per_sec"),
@@ -274,11 +333,63 @@ def make_handler(state: OrchestratorState):
                         "num_peers": state.cfg.num_peers,
                     },
                 )
+            elif self.path == "/membership":
+                self._reply(
+                    200,
+                    {
+                        "num_peers": state.cfg.num_peers,
+                        **state.cluster.membership(),
+                    },
+                )
             else:
                 self._reply(404, {"error": f"not found: {self.path}"})
 
         def do_POST(self) -> None:
             self._guarded(self._post)
+
+        def _membership_change(self, action: str) -> None:
+            """POST /join and /leave: membership is STATIC — the peer set
+            (keys, data shards, mesh) is provisioned at cluster build, so
+            /join can only re-admit a known, stopped node (the Node.start /
+            Node.stop lifecycle); an unknown peer_id is a 400, not a grow."""
+            doc, err = self._read_json_body()
+            if err is not None:
+                self._reply(400, {"error": err})
+                return
+            pid = doc.get("peer_id")
+            if not isinstance(pid, int) or isinstance(pid, bool):
+                self._reply(400, {"error": "peer_id must be an integer"})
+                return
+            if not 0 <= pid < state.cfg.num_peers:
+                self._reply(
+                    400,
+                    {
+                        "error": (
+                            f"unknown peer_id {pid}: membership is static "
+                            f"(cluster provisioned with num_peers="
+                            f"{state.cfg.num_peers}); /join re-admits a "
+                            "known stopped node, it cannot grow the cluster"
+                        )
+                    },
+                )
+                return
+            node = state.cluster.nodes[pid]
+            if action == "join":
+                already = node.running
+                node.start()
+                status = "already-live" if already else "joined"
+            else:
+                already = not node.running
+                node.stop()
+                status = "already-stopped" if already else "left"
+            self._reply(
+                200,
+                {
+                    "status": status,
+                    "peer_id": pid,
+                    **state.cluster.membership(),
+                },
+            )
 
         def _post(self) -> None:
             if self.path == "/start_training":
@@ -287,6 +398,10 @@ def make_handler(state: OrchestratorState):
                     self._reply(400, {"error": err})
                     return
                 self._reply(*state.start_training())
+            elif self.path == "/join":
+                self._membership_change("join")
+            elif self.path == "/leave":
+                self._membership_change("leave")
             else:
                 self._reply(404, {"error": f"not found: {self.path}"})
 
@@ -309,6 +424,7 @@ def serve_metrics(
     port: int = 9090,
     snapshot_fn: Optional[Callable[[], dict]] = None,
     recorder: Optional[flight.FlightRecorder] = None,
+    transport_stats_fn: Optional[Callable[[], dict]] = None,
 ) -> ThreadingHTTPServer:
     """Standalone exposition server: ``/metrics`` + ``/healthz`` +
     ``/flight`` with no orchestrator (and no jax import) attached.
@@ -318,7 +434,10 @@ def serve_metrics(
     disk instead, turning any recorded run into a scrape target.
     ``recorder`` likewise defaults to the process-wide flight recorder; a
     dedicated instance lets one process replay N distinct recorded streams
-    on N ports (the tower's test/bench topology)."""
+    on N ports (the tower's test/bench topology). ``transport_stats_fn``
+    (e.g. a live ``AsyncTCPTransport.transport_stats``) upgrades the
+    /healthz ``transport`` block to the full per-peer view — queue depths
+    included — instead of the telemetry-derived aggregate."""
     if snapshot_fn is None:
         snapshot_fn = telemetry.snapshot
 
@@ -327,7 +446,12 @@ def serve_metrics(
             self._guarded(self._get)
 
         def _get(self) -> None:
-            routed = _observability_get(self.path, snapshot_fn, recorder=recorder)
+            routed = _observability_get(
+                self.path,
+                snapshot_fn,
+                recorder=recorder,
+                transport_stats=transport_stats_fn,
+            )
             if routed is not None:
                 self._send(*routed)
             else:

@@ -51,6 +51,8 @@ __all__ = [
     "anomaly",
     "enabled",
     "set_enabled",
+    "set_recorder",
+    "using_recorder",
     "reset",
     "dump",
 ]
@@ -355,6 +357,40 @@ def enabled() -> bool:
 
 def set_enabled(on: bool) -> None:
     _RECORDER.enabled = on
+
+
+def set_recorder(rec: FlightRecorder) -> FlightRecorder:
+    """Swap the process-wide recorder; returns the previous one.
+
+    The per-host bit-identity story (lockstep chaos over real TCP vs the
+    same N logical hosts in one process) needs one *independent* event
+    stream per host — same per-stream ``n`` sequence in both deployments.
+    A worker process gets that for free from the process-global recorder;
+    the single-process baseline gets it by swapping in host ``k``'s
+    recorder while executing host ``k``'s handlers. Swapping is only
+    meaningful where handler execution is single-threaded per host (the
+    lockstep runner); concurrent planes should pass recorders explicitly.
+    """
+    global _RECORDER
+    prior = _RECORDER
+    _RECORDER = rec
+    return prior
+
+
+class using_recorder:
+    """Context manager form of :func:`set_recorder` (restores on exit)."""
+
+    def __init__(self, rec: FlightRecorder) -> None:
+        self._rec = rec
+        self._prior: Optional[FlightRecorder] = None
+
+    def __enter__(self) -> FlightRecorder:
+        self._prior = set_recorder(self._rec)
+        return self._rec
+
+    def __exit__(self, *exc: Any) -> None:
+        if self._prior is not None:
+            set_recorder(self._prior)
 
 
 def reset() -> None:

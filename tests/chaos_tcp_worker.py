@@ -1,0 +1,89 @@
+"""One chaos lockstep host as a real OS process — the TCP half of the
+bit-identity acceptance story.
+
+Launched N times by ``tests/test_chaos_tcp.py`` (and by ``bench.py``'s
+``multihost_tcp`` block): each process owns one ``LockstepHost``, records
+its flight stream into a process-local recorder served live over
+``serve_metrics``'s ``/flight``, runs the seeded scenario over loopback
+TCP via ``AsyncTCPTransport``, prints a single JSON verdict line, then
+parks on stdin so the parent can scrape the live endpoints and run
+``cli tower`` / ``cli audit`` against them before signalling exit.
+
+Deliberately jax-free: chaos acceptance must run wherever the control
+plane runs, devices or not.
+
+Usage: python chaos_tcp_worker.py '<json config>'
+
+Config keys: ``host_id``, ``ports`` (one transport port per host),
+``obs_port`` (this host's serve_metrics port), ``spec``
+(``ChaosSpec.to_dict()``), optional ``high_water``.
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    cfg = json.loads(sys.argv[1])
+
+    from p2pdl_tpu.runtime.lockstep import ChaosSpec, run_tcp_host
+    from p2pdl_tpu.runtime.server import serve_metrics
+    from p2pdl_tpu.utils import flight
+
+    spec = ChaosSpec.from_dict(cfg["spec"])
+    host_id = int(cfg["host_id"])
+    rec = flight.FlightRecorder(capacity=spec.capacity, enabled=True)
+    flight.set_recorder(rec)
+
+    stats_fn = {}
+
+    def transport_stats():
+        fn = stats_fn.get("fn")
+        if fn is None:
+            return {"transport": "aio"}
+        try:
+            return fn()
+        except Exception:
+            return {"transport": "aio"}
+
+    import threading
+
+    srv = serve_metrics(
+        port=int(cfg["obs_port"]), recorder=rec,
+        transport_stats_fn=transport_stats,
+    )
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    import time
+
+    t0 = time.perf_counter()
+    result = run_tcp_host(
+        spec,
+        host_id,
+        [int(p) for p in cfg["ports"]],
+        high_water=int(cfg.get("high_water", 512)),
+        on_channel=lambda ch: stats_fn.__setitem__(
+            "fn", ch.transport.transport_stats
+        ),
+    )
+    wall_s = time.perf_counter() - t0
+    verdict = {
+        "wall_s": round(wall_s, 4),
+        "host": host_id,
+        "digest": rec.determinism_digest(),
+        "events": len(rec.events(strip_time=True)),
+        "records": result["records"],
+        "transport": result["transport"],
+        "lost_sends": result["lost_sends"],
+        "obs_port": srv.server_address[1],
+    }
+    print(json.dumps(verdict), flush=True)
+    # Hold the live /flight endpoint open until the parent is done with it.
+    sys.stdin.readline()
+    srv.shutdown()
+    srv.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,340 @@
+"""Async transport plane: pooled framed TCP, backpressure, partitions,
+and cross-version interop with the legacy thread-per-connection peer."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from p2pdl_tpu.protocol.aio_transport import AsyncTCPTransport
+from p2pdl_tpu.protocol.transport import (
+    _LEN,
+    CONTROL_WIRE_VERSION,
+    TCPTransport,
+    recv_frame,
+    send_frame,
+)
+from p2pdl_tpu.utils import telemetry
+
+
+def _wait_for(pred, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+@pytest.fixture
+def aio_pair():
+    got1, got2 = [], []
+    t1 = AsyncTCPTransport(1, "127.0.0.1", 0, lambda s, d: got1.append((s, d)))
+    t2 = AsyncTCPTransport(2, "127.0.0.1", 0, lambda s, d: got2.append((s, d)))
+    t1.start()
+    t2.start()
+    t1.add_peer(2, "127.0.0.1", t2.port)
+    t2.add_peer(1, "127.0.0.1", t1.port)
+    yield t1, t2, got1, got2
+    t1.stop()
+    t2.stop()
+
+
+def test_aio_end_to_end_both_directions(aio_pair):
+    t1, t2, got1, got2 = aio_pair
+    assert t1.send(2, b"ping")
+    assert _wait_for(lambda: got2 == [(1, b"ping")])
+    assert t2.send(1, b"pong")
+    assert _wait_for(lambda: got1 == [(2, b"pong")])
+    assert not t1.send(99, b"no-such-peer")
+
+
+def test_aio_connection_is_pooled(aio_pair):
+    t1, t2, _, got2 = aio_pair
+    for i in range(5):
+        assert t1.send(2, b"m%d" % i)
+    assert _wait_for(lambda: len(got2) == 5)
+    assert [d for _, d in got2] == [b"m%d" % i for i in range(5)]
+    # One dial carried all five frames.
+    assert t1.transport_stats()["dialed"] == 1
+    assert t2.transport_stats()["accepted"] == 1
+
+
+def test_aio_backpressure_drops_newest_and_counts():
+    telemetry.reset()
+    t = AsyncTCPTransport(
+        1, "127.0.0.1", 0, lambda s, d: None, high_water=4,
+        dial_retries=0, dial_backoff_s=0.01,
+    )
+    t.start()
+    try:
+        # Point at a reserved-but-closed port: the worker stalls dialing,
+        # so the queue fills to exactly the high-water mark.
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+        t.add_peer(2, "127.0.0.1", dead_port)
+        results = [t.send(2, b"x%d" % i) for i in range(64)]
+        stats = t.transport_stats()
+        assert stats["queue_depth"].get("2", 0) <= 4
+        dropped = stats["backpressure_dropped"]
+        assert dropped >= 64 - 4 - stats["sent"] - stats["send_failed"] - 1
+        assert dropped == results.count(False)
+        counters = telemetry.snapshot("transport.backpressure_dropped")["counters"]
+        assert counters["transport.backpressure_dropped{transport=aio}"] == dropped
+    finally:
+        t.stop()
+        telemetry.reset()
+
+
+def test_aio_set_blocked_cuts_both_directions(aio_pair):
+    t1, t2, got1, got2 = aio_pair
+    assert t1.send(2, b"before")
+    assert _wait_for(lambda: got2 == [(1, b"before")])
+    t1.set_blocked({2})
+    assert t1.send(2, b"cut-tx") is False
+    # Inbound from a blocked peer is discarded too (the cut is symmetric
+    # per-host even when only one side applies the partition).
+    assert t2.send(1, b"cut-rx")
+    assert _wait_for(lambda: t1.transport_stats()["partition_refused"] >= 1)
+    assert got1 == []
+    t1.set_blocked(())
+    assert t1.send(2, b"healed")
+    assert _wait_for(lambda: got2[-1] == (1, b"healed"))
+
+
+def test_aio_fault_filter_drops_and_duplicates(aio_pair):
+    t1, t2, _, got2 = aio_pair
+
+    def fate(dst, data):
+        if data == b"drop-me":
+            return 0
+        if data == b"twice":
+            return 2
+        return 1
+
+    t1.fault_filter = fate
+    assert t1.send(2, b"drop-me")
+    assert t1.send(2, b"twice")
+    assert t1.send(2, b"clean")
+    assert _wait_for(lambda: len(got2) == 3)
+    assert [d for _, d in got2] == [b"twice", b"twice", b"clean"]
+    stats = t1.transport_stats()
+    assert stats["fault_dropped"] == 1
+
+
+def test_aio_stop_is_idempotent_and_leaves_no_threads():
+    t = AsyncTCPTransport(7, "127.0.0.1", 0, lambda s, d: None)
+    t.start()
+    t.stop()
+    t.stop()
+    assert all(
+        not th.name.startswith("aio-transport-7") for th in threading.enumerate()
+    )
+    assert t.send(2, b"x") is False  # sends after stop are refused
+
+
+def test_aio_stop_drains_pending_queue():
+    got = []
+    t1 = AsyncTCPTransport(1, "127.0.0.1", 0, lambda s, d: None)
+    t2 = AsyncTCPTransport(2, "127.0.0.1", 0, lambda s, d: got.append(d))
+    t1.start()
+    t2.start()
+    try:
+        t1.add_peer(2, "127.0.0.1", t2.port)
+        for i in range(20):
+            assert t1.send(2, b"drain-%d" % i)
+        t1.stop()  # graceful: flushes the queue before teardown
+        assert _wait_for(lambda: len(got) == 20)
+        assert got == [b"drain-%d" % i for i in range(20)]
+    finally:
+        t1.stop()
+        t2.stop()
+
+
+def test_aio_oversize_frame_rejected():
+    telemetry.reset()
+    t = AsyncTCPTransport(1, "127.0.0.1", 0, lambda s, d: None)
+    t.start()
+    try:
+        with socket.create_connection(("127.0.0.1", t.port)) as s:
+            s.sendall((1 << 31).to_bytes(4, "big") + b"tail")
+            # Server closes on the unframeable prefix.
+            s.settimeout(5.0)
+            assert s.recv(1) == b""
+        counters = telemetry.snapshot("transport.messages")["counters"]
+        assert counters["transport.messages{event=rejected,transport=aio}"] == 1
+    finally:
+        t.stop()
+        telemetry.reset()
+
+
+def test_aio_healthz_stats_shape(aio_pair):
+    t1, _, _, _ = aio_pair
+    assert t1.send(2, b"x")
+    assert _wait_for(lambda: t1.transport_stats()["sent"] == 1)
+    stats = t1.transport_stats()
+    for key in (
+        "transport", "open_connections", "dialed", "accepted", "retries",
+        "sent", "delivered", "send_failed", "backpressure_dropped",
+        "partition_refused", "fault_dropped", "high_water", "blocked_peers",
+        "queue_depth",
+    ):
+        assert key in stats
+    assert stats["transport"] == "aio"
+    assert isinstance(stats["queue_depth"], dict)
+
+
+def test_healthz_serves_live_transport_block():
+    """serve_metrics(transport_stats_fn=...) surfaces the async plane's
+    full per-peer stats under /healthz -> transport; without the handle the
+    block is reconstructed from transport.* telemetry (both shapes carry
+    the counters the chaos runbook needs)."""
+    import json
+    import urllib.request
+
+    from p2pdl_tpu.runtime.server import serve_metrics
+
+    telemetry.reset()
+    got = []
+    t1 = AsyncTCPTransport(1, "127.0.0.1", 0, lambda s, d: None)
+    t2 = AsyncTCPTransport(2, "127.0.0.1", 0, lambda s, d: got.append(d))
+    t1.start()
+    t2.start()
+    srv = serve_metrics(port=0, transport_stats_fn=t1.transport_stats)
+    plain = serve_metrics(port=0)
+    import threading as _threading
+
+    for s in (srv, plain):
+        _threading.Thread(target=s.serve_forever, daemon=True).start()
+    try:
+        t1.add_peer(2, "127.0.0.1", t2.port)
+        assert t1.send(2, b"observable")
+        assert _wait_for(lambda: got == [b"observable"])
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as r:
+            block = json.loads(r.read())["transport"]
+        assert block["transport"] == "aio"
+        assert block["sent"] == 1
+        assert block["open_connections"] == 1
+        assert isinstance(block["queue_depth"], dict)
+        # Telemetry-derived fallback: aggregate counters, no per-peer view.
+        port = plain.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as r:
+            derived = json.loads(r.read())["transport"]
+        assert derived["sent"] == 1.0
+        assert derived["delivered"] == 1.0
+        assert derived["dialed"] == 1.0
+        assert derived["accepted"] == 1.0
+        assert derived["backpressure_dropped"] == 0
+        assert "queue_depth" not in derived
+    finally:
+        for s in (srv, plain):
+            s.shutdown()
+            s.server_close()
+        t1.stop()
+        t2.stop()
+        telemetry.reset()
+
+
+# ------------------------------------------------- cross-version interop
+
+
+def test_wire_version_is_pinned_at_v3():
+    assert CONTROL_WIRE_VERSION == 3
+
+
+def test_legacy_peer_sends_to_async_plane():
+    """A v1/v2-speaking TCPTransport (fresh connection per frame, no trace
+    key) delivers into the async plane unchanged."""
+    got = []
+    done = threading.Event()
+
+    def handler(src, data):
+        got.append((src, data))
+        if len(got) == 2:
+            done.set()
+
+    aio = AsyncTCPTransport(1, "127.0.0.1", 0, handler)
+    aio.start()
+    legacy = TCPTransport(2, "127.0.0.1", 0, lambda s, d: None)
+    legacy.start()
+    try:
+        legacy.add_peer(1, "127.0.0.1", aio.port)
+        assert legacy.send(1, b'{"kind": "send", "v1": true}')
+        assert legacy.send(1, b'{"v": 2, "type": "batch"}')
+        assert done.wait(5.0)
+        assert got == [
+            (2, b'{"kind": "send", "v1": true}'),
+            (2, b'{"v": 2, "type": "batch"}'),
+        ]
+    finally:
+        legacy.stop()
+        aio.stop()
+
+
+def test_async_plane_sends_to_legacy_peer():
+    """The async plane's pooled sender survives the legacy peer's
+    one-frame-then-close serve loop: the EOF watch invalidates the pooled
+    connection and the next frame re-dials."""
+    got = []
+    done = threading.Event()
+
+    def handler(src, data):
+        got.append((src, data))
+        if len(got) == 3:
+            done.set()
+
+    legacy = TCPTransport(2, "127.0.0.1", 0, handler)
+    legacy.start()
+    aio = AsyncTCPTransport(1, "127.0.0.1", 0, lambda s, d: None)
+    aio.start()
+    try:
+        aio.add_peer(2, "127.0.0.1", legacy.port)
+        for i in range(3):
+            assert aio.send(2, b"frame-%d" % i)
+            assert _wait_for(lambda: len(got) > i)
+            # The legacy server accepts one frame per connection, then
+            # closes. Wait for the EOF watch to retire the pooled
+            # connection so the next send provably takes the re-dial path
+            # (a frame racing the close is the protocol's retry domain,
+            # not the transport's).
+            assert _wait_for(
+                lambda: aio.transport_stats()["open_connections"] == 0
+            )
+        assert done.wait(5.0)
+        assert got == [(1, b"frame-%d" % i) for i in range(3)]
+        assert aio.transport_stats()["dialed"] == 3
+    finally:
+        aio.stop()
+        legacy.stop()
+
+
+def test_async_frame_bytes_match_legacy_wire_format():
+    """Byte-level pin: what the async plane puts on the wire is exactly the
+    legacy frame (len | 4-byte BE src | payload), so v1/v2/v3 parsing is
+    untouched."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    aio = AsyncTCPTransport(9, "127.0.0.1", 0, lambda s, d: None)
+    aio.start()
+    try:
+        aio.add_peer(3, "127.0.0.1", srv.getsockname()[1])
+        assert aio.send(3, b"payload-bytes")
+        conn, _ = srv.accept()
+        conn.settimeout(5.0)
+        frame = recv_frame(conn)
+        assert frame == _LEN.pack(9) + b"payload-bytes"
+        # And the reverse: a hand-rolled legacy frame parses on our side.
+        send_frame(conn, _LEN.pack(3) + b"reply")
+        conn.close()
+    finally:
+        aio.stop()
+        srv.close()

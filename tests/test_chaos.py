@@ -161,6 +161,67 @@ def test_injector_partition_lifecycle():
     assert inj.partition is None
 
 
+def test_frame_fate_is_route_keyed_and_order_independent():
+    """The transport-boundary fates are pure functions of (seed, round,
+    src, dst, route_seq) — traversal order must not matter, unlike the hub
+    hooks' global draw counter. This is what makes the schedule identical
+    across one in-memory mesh and N real TCP processes."""
+    plan = scenario("lossy", 8, 4, seed=11)
+    routes = [(s, d, q) for s in range(4) for d in range(4) for q in range(5) if s != d]
+
+    def run(order):
+        inj = FaultInjector(plan, 8)
+        inj.begin_round(1)
+        return {
+            (s, d, q): inj.frame_fate(1, s, d, q, size=64) for s, d, q in order
+        }
+
+    forward, backward = run(routes), run(list(reversed(routes)))
+    assert forward == backward
+    # Fates actually fire at these rates (lossy has every rate nonzero).
+    assert any(f["drop"] for f in forward.values())
+    assert any(f["copies"] == 2 for f in forward.values())
+    assert any(f["delay_ticks"] > 0 for f in forward.values())
+
+
+def test_frame_fate_crash_and_partition_faces():
+    plan = FaultPlan(
+        crashes=(CrashSpec(peer=2, at_round=1),),
+        partitions=(PartitionSpec(groups=((0, 1), (2, 3)), at_round=1, heal_round=2),),
+    )
+    inj = FaultInjector(plan, 4)
+    inj.begin_round(0)
+    assert not inj.frame_fate(0, 2, 0, 0)["drop"]
+    assert inj.partition_peers(0) == frozenset()
+    inj.begin_round(1)
+    # Crashed endpoints drop both directions at the frame boundary.
+    assert inj.frame_fate(1, 2, 0, 0)["drop"]
+    assert inj.frame_fate(1, 0, 2, 0)["drop"]
+    # The partition face mirrors InMemoryHub._cut.
+    assert inj.cut(0, 3) and inj.cut(3, 0) and not inj.cut(0, 1)
+    assert inj.partition_peers(0) == frozenset({2, 3})
+    assert inj.partition_peers(3) == frozenset({0, 1})
+    inj.begin_round(2)
+    assert inj.partition_peers(0) == frozenset()
+
+
+def test_frame_filter_drives_async_transport_fault_hook():
+    """frame_filter is the AsyncTCPTransport adapter: per-destination
+    counters, copies out, drops counted on the injector."""
+    plan = FaultPlan(drop_rate=0.5, seed=3)
+    inj = FaultInjector(plan, 4)
+    inj.begin_round(0)
+    fate = inj.frame_filter(my_id=1)
+    copies = [fate(2, b"x") for _ in range(40)]
+    assert set(copies) <= {0, 1, 2}
+    assert copies.count(0) > 0  # at 50% drop over 40 frames
+    # Same schedule on a rerun: pure function of the plan.
+    inj2 = FaultInjector(plan, 4)
+    inj2.begin_round(0)
+    fate2 = inj2.frame_filter(my_id=1)
+    assert [fate2(2, b"x") for _ in range(40)] == copies
+
+
 # ------------------------------------------- end-to-end survival (SPMD)
 
 # The driver's round functions need jax.shard_map; on older builds it only

@@ -195,3 +195,122 @@ def test_replayed_signed_frames_rejected():
         assert tp._decision is not None and tp._decision["round"] == 1
     finally:
         tp.stop()
+
+
+def _unit_plane(process_id: int, num_processes: int, host_addrs, **kw):
+    from p2pdl_tpu.config import Config
+    from p2pdl_tpu.parallel.mesh import make_mesh
+    from p2pdl_tpu.runtime import multihost
+
+    mesh = make_mesh(8)
+    topo = multihost.HostTopology(
+        process_id=process_id,
+        num_processes=num_processes,
+        local_devices=8 // num_processes,
+        global_devices=8,
+    )
+    cfg = Config(
+        num_peers=8, trainers_per_round=2, samples_per_peer=8, batch_size=8,
+        brb_enabled=True,
+    )
+    return multihost.MultiHostTrustPlane(cfg, topo, mesh, host_addrs, **kw)
+
+
+def test_control_plane_defaults_to_async_transport():
+    """The trust plane rides the pooled asyncio transport by default (and
+    the legacy plane stays selectable); its inbox pump is event-driven —
+    a frame landing from another thread wakes it well before the deadline
+    (the old queue pump polled at 50 ms granularity)."""
+    import threading
+    import time as _time
+
+    from p2pdl_tpu.protocol.aio_transport import AsyncTCPTransport
+    from p2pdl_tpu.protocol.transport import TCPTransport
+
+    ports = _free_ports(1)
+    tp = _unit_plane(0, 1, [("127.0.0.1", ports[0])])
+    try:
+        assert isinstance(tp.transport, AsyncTCPTransport)
+        assert tp.transport_stats()["transport"] == "aio"
+        fresh = tp._sign_frame(
+            {"t": "report", "host": 0, "round": 3, "delivered": {},
+             "payloads": {}, "attest": {}}
+        )
+        tp._active_round = 3
+        timer = threading.Timer(
+            0.2, lambda: tp._on_frame(json.dumps(fresh).encode())
+        )
+        t0 = _time.monotonic()
+        timer.start()
+        assert tp._pump(t0 + 30.0, lambda: 0 in tp._reports)
+        # Woken by the notify, not by deadline expiry.
+        assert _time.monotonic() - t0 < 5.0
+    finally:
+        tp.stop()
+    ports = _free_ports(1)
+    legacy = _unit_plane(0, 1, [("127.0.0.1", ports[0])], transport="tcp")
+    try:
+        assert isinstance(legacy.transport, TCPTransport)
+        assert legacy.transport_stats() == {"transport": "tcp"}
+    finally:
+        legacy.stop()
+
+
+def test_host_heartbeats_ride_the_async_plane():
+    """Failure-detector heartbeats are real probe/ack frames over the
+    control-plane sockets: two in-process planes see each other live, and
+    an injected deterministic heartbeat loss (the FaultInjector face)
+    filters the responded set without touching the wire."""
+    import threading
+
+    ports = _free_ports(2)
+    host_addrs = [("127.0.0.1", p) for p in ports]
+    a = _unit_plane(0, 2, host_addrs)
+    b = _unit_plane(1, 2, host_addrs)
+    try:
+        errs: list[BaseException] = []
+
+        def keys(plane):
+            try:
+                plane.exchange_keys(timeout_s=30.0)
+            except BaseException as e:  # pragma: no cover - surfaced below
+                errs.append(e)
+
+        ths = [threading.Thread(target=keys, args=(p,)) for p in (a, b)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(60.0)
+        assert not errs, errs
+
+        results: dict[int, set] = {}
+
+        def beat(plane, faults=None):
+            results[plane.topo.process_id] = plane.host_heartbeat(
+                0, timeout_s=10.0, faults=faults
+            )
+
+        ths = [threading.Thread(target=beat, args=(p,)) for p in (a, b)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(30.0)
+        assert results[0] == {0, 1}
+        assert results[1] == {0, 1}
+
+        class _LossyFaults:
+            def heartbeat_ok(self, round_idx, peer):
+                return peer != 1
+
+        ths = [
+            threading.Thread(target=beat, args=(a, _LossyFaults())),
+            threading.Thread(target=beat, args=(b,)),
+        ]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(30.0)
+        assert results[0] == {0}, "injected loss must drop host 1's heartbeat"
+    finally:
+        a.stop()
+        b.stop()

@@ -189,6 +189,138 @@ def test_http_server_endpoints(small_cfg, mesh8):
         server.server_close()
 
 
+def _post_json(url, doc, timeout=10):
+    body = json.dumps(doc).encode()
+    req = urllib.request.Request(
+        url, data=body, method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_http_membership_join_leave(small_cfg, mesh8):
+    """The orchestrator's membership API: /membership exposes the live /
+    suspected / stopped view, /leave stops a known node, /join re-admits
+    it, and an unknown peer_id is a 400 (static membership — the cluster
+    never grows past its provisioned peer set)."""
+    import jax
+
+    from p2pdl_tpu.runtime.server import serve
+
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("cluster round fn needs jax.shard_map in this jax build")
+    server = serve(small_cfg.replace(rounds=1), port=0)
+    port = server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        with urllib.request.urlopen(f"{base}/membership", timeout=10) as r:
+            view = json.loads(r.read())
+        assert view["num_peers"] == 8
+        assert view["live"] == list(range(8))
+        assert view["stopped"] == []
+
+        out = _post_json(f"{base}/leave", {"peer_id": 3})
+        assert out["status"] == "left"
+        assert out["stopped"] == [3]
+        assert 3 not in out["live"]
+        # Idempotent: leaving a stopped node reports, never errors.
+        assert _post_json(f"{base}/leave", {"peer_id": 3})["status"] == (
+            "already-stopped"
+        )
+
+        out = _post_json(f"{base}/join", {"peer_id": 3})
+        assert out["status"] == "joined"
+        assert out["stopped"] == []
+        assert 3 in out["live"]
+        assert _post_json(f"{base}/join", {"peer_id": 3})["status"] == (
+            "already-live"
+        )
+
+        # Static membership: unknown ids and garbage bodies fail closed.
+        for doc in ({"peer_id": 99}, {"peer_id": "three"}, {"peer_id": True}):
+            req = urllib.request.Request(
+                f"{base}/join", data=json.dumps(doc).encode(), method="POST"
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 400
+        # /healthz carries the transport block on the orchestrator too.
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            health = json.loads(r.read())
+        assert "transport" in health
+        assert "backpressure_dropped" in health["transport"]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_membership_routes_without_device_round():
+    """The same /membership + /join + /leave route logic against a stub
+    cluster (real Node lifecycle, no jax round function): the handler's
+    membership semantics must not depend on a compiled experiment."""
+    import types
+
+    from http.server import ThreadingHTTPServer
+
+    from p2pdl_tpu.runtime.cluster import Node
+    from p2pdl_tpu.runtime.server import make_handler
+
+    class StubCluster:
+        def __init__(self, n):
+            self._stopped: set[int] = set()
+            self.cfg = types.SimpleNamespace(round_timeout_s=1.0)
+            self.nodes = [Node(self, i, "127.0.0.1", 7001 + i) for i in range(n)]
+            self.experiment = types.SimpleNamespace(records=[])
+
+        def _set_stopped(self, node_id, stopped):
+            if stopped:
+                self._stopped.add(node_id)
+            else:
+                self._stopped.discard(node_id)
+
+        def membership(self):
+            return {
+                "live": [p for p in range(8) if p not in self._stopped],
+                "suspected": [],
+                "stopped": sorted(self._stopped),
+            }
+
+    state = types.SimpleNamespace(
+        cfg=types.SimpleNamespace(num_peers=8),
+        cluster=StubCluster(8),
+        lock=threading.Lock(),
+        training=False,
+    )
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
+    port = server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        out = _post_json(f"{base}/leave", {"peer_id": 5})
+        assert out["status"] == "left" and out["stopped"] == [5]
+        assert not state.cluster.nodes[5].running
+        out = _post_json(f"{base}/join", {"peer_id": 5})
+        assert out["status"] == "joined" and out["stopped"] == []
+        assert state.cluster.nodes[5].running
+        with urllib.request.urlopen(f"{base}/membership", timeout=10) as r:
+            view = json.loads(r.read())
+        assert view["live"] == list(range(8))
+        req = urllib.request.Request(
+            f"{base}/join", data=json.dumps({"peer_id": 8}).encode(),
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 400
+        assert "static" in json.loads(e.value.read())["error"]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
 def test_cli_run(capsys, mesh8):
     from p2pdl_tpu.cli import main
 

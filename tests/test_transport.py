@@ -291,6 +291,35 @@ def test_tcp_send_recovers_on_retry_when_listener_appears():
         telemetry.reset()
 
 
+def test_tcp_stop_joins_all_connection_threads():
+    """The lifecycle regression: connection threads parked mid-recv must
+    not outlive stop(), and stop() must be idempotent."""
+
+    def serve_threads():
+        return [th for th in threading.enumerate() if th.name == "tcp-serve-1"]
+
+    t = TCPTransport(1, "127.0.0.1", 0, lambda s, d: None)
+    t.start()
+    socks = []
+    try:
+        # Park three connections mid-frame (partial length header) so the
+        # serve threads block inside recv.
+        for _ in range(3):
+            s = socket.create_connection(("127.0.0.1", t.port))
+            s.sendall(b"\x00")
+            socks.append(s)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and len(serve_threads()) < 3:
+            time.sleep(0.01)
+        assert len(serve_threads()) >= 3
+        t.stop()
+        assert serve_threads() == []
+        t.stop()  # idempotent: second call is a no-op, not an error
+    finally:
+        for s in socks:
+            s.close()
+
+
 def test_batch_trace_header_roundtrips_and_is_signed():
     """Wire v3: the batch trace tag survives the wire, is covered by the
     signature (tagged vs untagged signing bytes differ), and a v2 parser
