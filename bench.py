@@ -746,6 +746,82 @@ def multihost_tcp_block(num_hosts: int = 3) -> dict:
     }
 
 
+def compression_block(feat_d: int = 4096, rounds: int = 3) -> dict:
+    """The bench JSON's ``compression`` block: dense f32 rows vs the
+    topk(0.01)+int8 compressed wire format, shipped over real loopback
+    ``AsyncTCPTransport`` connections at T in {64, 256, 1024} trainer rows.
+
+    ``bytes_per_round`` is measured at the RECEIVER (the transport's
+    ``rx_bytes`` counter, not the encoder's arithmetic) so the ratio is an
+    honest wire number; ``compression_ratio`` = dense/compressed bytes per
+    round (the >=4x acceptance line at T=1024). ``rounds_per_sec`` times
+    send-all-rows-then-drain per variant. Host-only (numpy codec path, no
+    jax); each size degrades to an error row, never a lost block.
+    """
+    import threading as _threading
+
+    from p2pdl_tpu.ops import delta_codec
+    from p2pdl_tpu.protocol.aio_transport import AsyncTCPTransport
+
+    ratio = 0.01
+    out: dict = {"d": feat_d, "mode": "topk+int8", "ratio": ratio}
+
+    def ship(payloads: list[bytes], n_rounds: int) -> tuple[float, float]:
+        """Send every payload ``n_rounds`` times sender->receiver over
+        loopback, draining fully each round; returns (rounds_per_sec,
+        receiver bytes_per_round)."""
+        got = _threading.Semaphore(0)
+        rx = AsyncTCPTransport(1, "127.0.0.1", 0, lambda s, d: got.release())
+        tx = AsyncTCPTransport(
+            0, "127.0.0.1", 0, lambda s, d: None, high_water=4096
+        )
+        try:
+            rx.start()
+            tx.start()
+            tx.add_peer(1, "127.0.0.1", rx.port)
+            t0 = time.perf_counter()
+            for _ in range(n_rounds):
+                for data in payloads:
+                    deadline = time.monotonic() + 30.0
+                    while not tx.send(1, data):  # backpressure: retry
+                        if time.monotonic() >= deadline:
+                            raise RuntimeError("loopback send refused for 30s")
+                        time.sleep(0.001)
+                for _ in payloads:
+                    if not got.acquire(timeout=60.0):
+                        raise RuntimeError("loopback drain timed out")
+            wall = time.perf_counter() - t0
+            rx_bytes = rx.transport_stats()["rx_bytes"]
+        finally:
+            tx.stop()
+            rx.stop()
+        return n_rounds / wall if wall > 0 else 0.0, rx_bytes / n_rounds
+
+    for t in (64, 256, 1024):
+        try:
+            rng = np.random.default_rng(t)
+            x = rng.normal(size=(t, feat_d)).astype(np.float32)
+            k = delta_codec.topk_count(feat_d, ratio)
+            comp = delta_codec.encode_np(x, "topk", k)
+            dense_rows = [x[i].tobytes() for i in range(t)]
+            comp_rows = [comp[i].tobytes() for i in range(t)]
+            dense_rps, dense_bpr = ship(dense_rows, rounds)
+            comp_rps, comp_bpr = ship(comp_rows, rounds)
+            out[f"t{t}"] = {
+                "k": k,
+                "dense_bytes_per_round": int(dense_bpr),
+                "bytes_per_round": int(comp_bpr),
+                "compression_ratio": (
+                    round(dense_bpr / comp_bpr, 2) if comp_bpr else None
+                ),
+                "dense_rounds_per_sec": round(dense_rps, 2),
+                "rounds_per_sec": round(comp_rps, 2),
+            }
+        except Exception as e:  # noqa: BLE001 - one size failing is a row note
+            out[f"t{t}"] = {"error": str(e)[:300]}
+    return out
+
+
 def aggregator_block() -> dict:
     """The bench JSON's ``aggregators`` block: fused Pallas kernel vs the
     dense XLA Gram path for the ``[T, T]`` pairwise-distance assembly, per
@@ -1694,6 +1770,12 @@ def main() -> None:
         rec["multihost_tcp"] = multihost_tcp_block()
     except Exception as e:  # noqa: BLE001 - headline must still print
         rec["multihost_tcp"] = {"error": str(e)[:300]}
+    # Dense-vs-compressed wire bytes over loopback TCP (compressed-delta
+    # format), same degrade contract.
+    try:
+        rec["compression"] = compression_block()
+    except Exception as e:  # noqa: BLE001 - headline must still print
+        rec["compression"] = {"error": str(e)[:300]}
     # Probe forensics ride the SUCCESS tail too (not just unreachable
     # records): a CPU-fallback headline carries the accelerator attempts
     # it fell back from (re-exec'd in via P2PDL_BENCH_PROBE_DIAGNOSTICS),

@@ -20,7 +20,10 @@ before they touch protocol state, allocation sizes, or parsers.
 - **Sinks** — protocol-state writes (``self.state[...] = ...`` and
   mutator calls) in protocol/runtime classes, reads or allocations sized
   by a tainted integer (``read(n)`` / ``recv(n)`` / ``bytearray(n)`` /
-  ``range(n)`` — the 4096x amplification shape), ``struct.unpack``
+  ``range(n)``, plus the decompression buffers ``zeros(n)`` /
+  ``empty(n)`` / ``frombuffer(buf, count=n)`` — the 4096x amplification
+  shape: a codec that trusts a wire-carried element count allocates
+  attacker-chosen memory before any signature check), ``struct.unpack``
   windows positioned by a tainted offset, and ``json.loads`` of an
   unverified payload.
 
@@ -64,7 +67,9 @@ _SIZED_READS = frozenset(
         "read_exact", "readexactly",
     }
 )
-_SIZED_ALLOCS = frozenset({"bytearray", "range"})
+_SIZED_ALLOCS = frozenset(
+    {"bytearray", "range", "zeros", "empty", "frombuffer"}
+)
 
 
 def _last_segment(mod: ModuleInfo, func: ast.AST) -> str:
@@ -205,7 +210,10 @@ class WireTaintRule(ProgramRule):
         "wire-derived data reaches protocol state, an allocation size, or a "
         "parser without signature verification or shape validation"
     )
-    scope = ("protocol/", "runtime/")
+    # ops/ joined when the compressed-delta codec landed: decode paths
+    # allocate buffers sized by wire-carried counts, exactly the
+    # amplification shape this rule exists to catch.
+    scope = ("protocol/", "runtime/", "ops/")
 
     def check_program(self, program: Program) -> Iterable[Finding]:
         if not any(self.applies(m) for m in program.mods):

@@ -72,6 +72,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="quantization levels for --compress qsgd (256 ~ 8-bit)",
     )
     p.add_argument(
+        "--delta-compression", choices=("none", "int8", "bf16", "topk"),
+        default="none",
+        help="compressed-delta WIRE format for the BRB trust pipeline "
+        "(requires --brb): the pack/digest/ship bytes are int8-quantized, "
+        "bf16-truncated, or magnitude top-k sparsified (fraction from "
+        "--compress-ratio), and aggregation consumes the codec roundtrip — "
+        "digests are computed over the compressed bytes",
+    )
+    p.add_argument(
         "--selection", choices=("uniform", "random", "power_of_choice"),
         default="uniform",
         help="trainer sampler: uniform (reference semantics; 'random' is "
@@ -532,6 +541,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         fednova=args.fednova,
         compress=args.compress,
         compress_ratio=args.compress_ratio,
+        delta_compression=args.delta_compression,
         qsgd_levels=args.qsgd_levels,
         dp_clip=args.dp_clip,
         dp_noise_multiplier=args.dp_noise_multiplier,
@@ -625,6 +635,7 @@ def flight_summary_from_events(events: list[dict]) -> dict:
 # are carried as informational rows that can never fail the gate.
 _HIGHER_BETTER = (
     "per_sec", "mfu", "efficiency", "flops_per_sec", "_acc", "speedup",
+    "compression_ratio",
 )
 _LOWER_BETTER = (
     "latency", "recompile", "loss", "bytes", "_memory", "duration", "_s",
@@ -657,6 +668,12 @@ _LEAF_THRESHOLDS = {
     "dense_s": 0.25,
     "fused_s": 0.25,
     "speedup": 0.20,
+    # Compression-block leaves: byte counts are deterministic for a given
+    # layout, so any growth at all is a real wire regression — keep the
+    # band tight. The ratio divides two such counts and inherits the same.
+    "bytes_per_round": 0.01,
+    "compressed_bytes": 0.01,
+    "compression_ratio": 0.01,
 }
 
 

@@ -124,6 +124,19 @@ class Config:
     compress: str = "none"  # "none" | "topk" | "qsgd"
     compress_ratio: float = 0.1  # topk: fraction of coordinates kept
     qsgd_levels: int = 256  # qsgd: quantization levels (256 ~ 8-bit)
+    # Compressed-delta WIRE format (ops/delta_codec): unlike ``compress``
+    # above — a simulation-only transform riding the scan carry — this
+    # changes the bytes the trust plane actually packs, digests, BRB-signs
+    # and ships ("what is signed is what is shipped"), and what aggregation
+    # consumes (the codec roundtrip of each raw delta). "int8" = per-row
+    # symmetric 8-bit quantization (+f32 scale), "bf16" = bfloat16 value
+    # truncation, "topk" = magnitude top-k (fraction ``compress_ratio``)
+    # with int8 values and u32 index runs. Requires the BRB trust pipeline
+    # (it IS that pipeline's wire format) and is mutually exclusive with
+    # the delta transforms that would reorder around the codec roundtrip
+    # (see validation). Default "none": every existing bit-identity pin is
+    # untouched.
+    delta_compression: str = "none"  # "none" | "int8" | "bf16" | "topk"
     # SCAFFOLD (Karimireddy et al., ICML 2020): control variates correct
     # client drift at every LOCAL STEP — each peer keeps c_i, the server
     # keeps c, local steps use g + c - c_i, and after K local steps
@@ -712,6 +725,57 @@ class Config:
             # ops/compression.kth_magnitude_sharded) — selection, shipping,
             # and the EF residual then stay shard-local; the residual stack
             # places like the optimizer state.
+        if self.delta_compression not in ("none", "int8", "bf16", "topk"):
+            raise ValueError(
+                f"unknown delta_compression {self.delta_compression!r}; one "
+                f"of ('none', 'int8', 'bf16', 'topk')"
+            )
+        if self.delta_compression != "none":
+            # The codec is the TRUST PIPELINE's wire format: the compressed
+            # pack is what BRB digests and signs, and the aggregate phase
+            # consumes the codec roundtrip. Everything excluded below would
+            # break the "what is signed is what is shipped" equation — a
+            # transform between the signed bytes and the aggregated value.
+            if not self.brb_enabled:
+                raise ValueError(
+                    "delta_compression is the BRB trust pipeline's wire "
+                    "format; set brb_enabled=True (without the trust plane "
+                    "nothing ships, so there is nothing to compress)"
+                )
+            if self.compress != "none":
+                raise ValueError(
+                    "delta_compression (wire format) and compress "
+                    "(simulation-only transform) cannot compose: the scan-"
+                    "carry compressor would alter deltas after the wire "
+                    "bytes were signed"
+                )
+            if self.aggregator in ("gossip", "secure_fedavg"):
+                raise ValueError(
+                    "delta_compression requires a plain or robust delta "
+                    "aggregator: gossip mixes params, and secure-agg masks "
+                    "are calibrated to dense f32 rows (a quantized masked "
+                    "sum no longer cancels)"
+                )
+            if self.dp_clip > 0.0 or self.dp_noise_multiplier > 0.0:
+                raise ValueError(
+                    "delta_compression with DP is not supported: "
+                    "quantization after clipping is a data-dependent "
+                    "transform the sensitivity calibration does not cover"
+                )
+            if self.scaffold or self.fednova:
+                raise ValueError(
+                    "delta_compression with scaffold/fednova is not yet "
+                    "supported: both rescale deltas inside the aggregate "
+                    "phase, which would land between the signed bytes and "
+                    "the aggregated value"
+                )
+            if self.delta_compression == "topk" and not (
+                0.0 < self.compress_ratio <= 1.0
+            ):
+                raise ValueError(
+                    f"delta_compression='topk' reuses compress_ratio, which "
+                    f"must be in (0, 1], got {self.compress_ratio}"
+                )
         if self.scaffold:
             if self.aggregator != "fedavg":
                 raise ValueError(

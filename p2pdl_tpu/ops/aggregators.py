@@ -42,8 +42,23 @@ from p2pdl_tpu.ops import pallas_aggregators
 # PATH_TOLERANCE_ATOL_CORRELATED. tests/test_sharded_aggregators.py
 # asserts both; a change that needs looser bounds should widen the
 # contract here, not per-test.
+#
+# The COMPRESSED path (``ops.compressed_aggregators``, fed from the
+# int8/top-k wire buffers of ``ops.delta_codec``) joins the contract with
+# one twist: its reference point is the dense reducer applied to the
+# ROUNDTRIPPED deltas (scale*q — the exact values the wire delivers), not
+# the original floats, so quantization error itself never enters the
+# comparison. On that footing the dequantize-free FedAvg/Krum/clip paths
+# are ordinary summation-order reshuffles and hold PATH_TOLERANCE_ATOL;
+# the exception is Gram-space centering (``gram_compressed(center=True)``
+# subtracts O(offset^2) row/column means where the dense path centers the
+# rows first), which loses ~offset/spread relative bits in the correlated
+# regime exactly like the uncentered terms above — those comparisons use
+# PATH_TOLERANCE_ATOL_COMPRESSED. tests/test_compressed_aggregators.py
+# asserts both footings.
 PATH_TOLERANCE_ATOL = 5e-5
 PATH_TOLERANCE_ATOL_CORRELATED = 1e-3
+PATH_TOLERANCE_ATOL_COMPRESSED = 1e-3
 
 
 def fedavg(deltas: Any, weights: jnp.ndarray | None = None) -> Any:

@@ -1,0 +1,191 @@
+"""Robust reducers over compressed-delta wire buffers — dequantize-free.
+
+The compressed wire format (``ops/delta_codec``) ships each trainer row as
+int8 codes ``q`` with one f32 ``scale`` per row (plus top-k indices in
+sparse mode). The receiver-visible update is ``u_i = scale_i * q_i`` — and
+every reducer the round needs is expressible directly on ``(q, scale)``
+without ever materializing the dequantized ``[T, D]`` float matrix:
+
+- **FedAvg** is a weighted sum ``sum_i w_i s_i q_i``: fold the scale into
+  the weight and it is ONE f32 matvec against the int8 codes.
+- **Krum / Bulyan-style selection** need the pairwise-distance matrix,
+  which needs only the Gram matrix: ``u_i . u_j = s_i s_j (q_i . q_j)`` —
+  the int8 Gram ``q @ q^T`` (integer-exact in f32 accumulation up to 2^24)
+  scaled by ``outer(s, s)``.
+- **Centered clipping / geometric median** run their whole iteration in
+  Gram space already (``sharded_aggregators._dists_from_gram``); fed from
+  the compressed Gram, the iterate stays a ``[T]`` coefficient vector and
+  the final extraction is again one ``(c * s) @ q`` matvec.
+
+Equivalence contract: each reducer here computes the same real-arithmetic
+quantity as its dense counterpart in ``ops.aggregators`` applied to the
+ROUNDTRIPPED deltas (``delta_codec.roundtrip_*`` — the exact values the
+wire delivers), so the pair agrees to the cross-path tolerance
+(:data:`~p2pdl_tpu.ops.aggregators.PATH_TOLERANCE_ATOL`; the correlated
+regime and Gram-space centering fall under
+:data:`~p2pdl_tpu.ops.aggregators.PATH_TOLERANCE_ATOL_COMPRESSED`) — see
+the contract block in ``ops/aggregators.py``. tests/test_compressed_aggregators.py
+asserts every pair.
+
+Top-k sparse rows densify once per leaf before Gram work (the wire saving
+is bytes, not FLOPs — scatter of ``[T, k]`` into ``[T, n]`` is cheap and
+MXU-aligned afterwards), but FedAvg stays scatter-only: ``O(T k)`` adds.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def dequantize(q: jnp.ndarray, scales: jnp.ndarray) -> jnp.ndarray:
+    """``[T, n]`` f32 receiver-visible rows ``u_i = s_i q_i`` (the oracle
+    bridge to the dense reducers; the reducers below never call it)."""
+    return q.astype(jnp.float32) * scales[:, None].astype(jnp.float32)
+
+
+def densify_topk(
+    idx: jnp.ndarray, q: jnp.ndarray, scales: jnp.ndarray, n: int
+) -> jnp.ndarray:
+    """Scatter sparse top-k rows ``(idx, q) [T, k]`` into dense ``[T, n]``
+    f32 ``u`` rows."""
+    t = q.shape[0]
+    deq = q.astype(jnp.float32) * scales[:, None].astype(jnp.float32)
+    return (
+        jnp.zeros((t, n), jnp.float32)
+        .at[jnp.arange(t)[:, None], idx.astype(jnp.int32)]
+        .set(deq)
+    )
+
+
+def _norm_weights(t: int, weights: Optional[jnp.ndarray]) -> jnp.ndarray:
+    if weights is None:
+        return jnp.full((t,), 1.0 / t, jnp.float32)
+    w = weights.astype(jnp.float32)
+    return w / (jnp.sum(w) + 1e-12)
+
+
+def fedavg_int8(
+    q: jnp.ndarray, scales: jnp.ndarray, weights: Optional[jnp.ndarray] = None
+) -> jnp.ndarray:
+    """Weighted mean of dequantized rows as ONE matvec: ``(w * s) @ q``.
+
+    ``q`` ``[T, n]`` int8, ``scales`` ``[T]`` f32; weights default uniform
+    (plain FedAvg) and are normalized like ``aggregators.fedavg``."""
+    w = _norm_weights(q.shape[0], weights) * scales.astype(jnp.float32)
+    return jnp.einsum(
+        "t,tn->n", w, q.astype(jnp.float32), preferred_element_type=jnp.float32
+    )
+
+
+def fedavg_topk(
+    idx: jnp.ndarray,
+    q: jnp.ndarray,
+    scales: jnp.ndarray,
+    n: int,
+    weights: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Sparse weighted mean: ``O(T k)`` scatter-adds, never a dense
+    ``[T, n]`` intermediate. ``idx``/``q`` ``[T, k]``, result ``[n]`` f32."""
+    t = q.shape[0]
+    w = (_norm_weights(t, weights) * scales.astype(jnp.float32))[:, None]
+    vals = w * q.astype(jnp.float32)  # [T, k]
+    return jnp.zeros((n,), jnp.float32).at[idx.astype(jnp.int32).reshape(-1)].add(
+        vals.reshape(-1)
+    )
+
+
+def gram_compressed(
+    q: jnp.ndarray, scales: jnp.ndarray, *, center: bool = True
+) -> jnp.ndarray:
+    """``[T, T]`` f32 Gram matrix of the dequantized rows, dequantize-free:
+    ``G = (q @ q^T) * outer(s, s)``. The int8 cross products are
+    integer-valued and f32 accumulation keeps them exact up to 2^24, so the
+    only rounding is the two scale multiplies.
+
+    ``center=True`` projects out the row mean IN GRAM SPACE
+    (``G - rowmean - colmean + totalmean`` — the Gram of mean-centered
+    rows in exact arithmetic). Unlike the dense path's center-the-rows-
+    first, this subtracts O(offset^2) entries, so correlated-regime
+    comparisons against the dense centered Gram carry
+    ``PATH_TOLERANCE_ATOL_COMPRESSED``."""
+    qf = q.astype(jnp.float32)
+    s = scales.astype(jnp.float32)
+    g = jnp.einsum("in,jn->ij", qf, qf, preferred_element_type=jnp.float32)
+    g = g * (s[:, None] * s[None, :])
+    if center:
+        row = jnp.mean(g, axis=1, keepdims=True)
+        col = jnp.mean(g, axis=0, keepdims=True)
+        g = g - row - col + jnp.mean(g)
+    return g
+
+
+def pairwise_sq_dists_compressed(
+    q: jnp.ndarray, scales: jnp.ndarray
+) -> jnp.ndarray:
+    """``[T, T]`` clamped squared L2 distances between dequantized rows,
+    assembled from the (centered) compressed Gram — the compressed
+    counterpart of ``aggregators.pairwise_sq_dists`` for one leaf."""
+    g = gram_compressed(q, scales, center=True)
+    sq = jnp.diagonal(g)
+    return jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * g, 0.0)
+
+
+def krum_scores_compressed(
+    q: jnp.ndarray, scales: jnp.ndarray, f: int
+) -> jnp.ndarray:
+    """Krum scores straight off the compressed distance matrix (same
+    selection rule and ``T >= 2f+3`` guard as ``aggregators.krum_scores``)."""
+    d = pairwise_sq_dists_compressed(q, scales)
+    t = d.shape[0]
+    if t < 2 * f + 3:
+        raise ValueError(f"krum requires T >= 2f+3 ({2 * f + 3}), got T={t}")
+    k = t - f - 2
+    d = d + jnp.diag(jnp.full((t,), jnp.inf, d.dtype))
+    d_sorted = jnp.sort(d, axis=1)
+    return jnp.sum(d_sorted[:, :k], axis=1)
+
+
+def krum_compressed(q: jnp.ndarray, scales: jnp.ndarray, f: int) -> jnp.ndarray:
+    """Krum winner's dequantized row ``[n]`` f32 — selection happens on the
+    scores, only the single winning row is ever dequantized."""
+    best = jnp.argmin(krum_scores_compressed(q, scales, f))
+    return q[best].astype(jnp.float32) * scales[best].astype(jnp.float32)
+
+
+def centered_clip_compressed(
+    q: jnp.ndarray,
+    scales: jnp.ndarray,
+    tau: float = 0.0,
+    iters: Optional[int] = None,
+) -> jnp.ndarray:
+    """Centered clipping fed from the compressed Gram: the whole iteration
+    is ``sharded_aggregators._dists_from_gram``'s coefficient-space loop
+    (``c' = (1 - mean_i s_i) c + s / T``), and the final iterate
+    ``v = sum_i c_i u_i`` is extracted by one ``(c * s) @ q`` matvec.
+    Auto-``tau`` (``tau <= 0``) re-estimates the clip radius as the median
+    distance each iteration, exactly like the dense and sharded paths."""
+    import jax
+
+    from p2pdl_tpu.ops.aggregators import CCLIP_ITERS
+    from p2pdl_tpu.ops.sharded_aggregators import _dists_from_gram
+
+    if not iters:
+        iters = CCLIP_ITERS
+    sub = gram_compressed(q, scales, center=True)
+    t = sub.shape[0]
+
+    def step(_, c):
+        d = _dists_from_gram(sub, c)
+        tau_eff = jnp.where(tau > 0, jnp.float32(tau), jnp.median(d))
+        s = jnp.minimum(1.0, tau_eff / jnp.maximum(d, 1e-12))
+        return (1.0 - jnp.mean(s)) * c + s / t
+
+    c = jax.lax.fori_loop(0, iters, step, jnp.full((t,), 1.0 / t, jnp.float32))
+    return jnp.einsum(
+        "t,tn->n",
+        c * scales.astype(jnp.float32),
+        q.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )

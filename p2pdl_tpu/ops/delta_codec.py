@@ -1,0 +1,427 @@
+"""Compressed-delta wire format: layout, encode/decode, and digest metadata.
+
+This module defines the ONE wire layout shared by every layer that touches
+compressed deltas — the on-device pack kernels (`ops/pallas_codec`, the XLA
+fallback in `parallel/round.build_compressed_pack_fn`), the BRB digesters
+(`protocol/crypto.make_segment_digester`), the compressed-domain reducers
+(`ops/compressed_aggregators`), the lockstep harness, and `bench.py`. The
+numpy reference implementation here is the normative one: the jax encoders
+must produce bitwise-identical buffers on CPU (pinned by tests), and the
+digest-over-compressed-bytes invariant means "what is signed is what is
+shipped" only holds while every encoder agrees byte for byte.
+
+Wire layout (little-endian, per trainer row, one segment per leaf, leaves in
+``jax.tree_util`` flatten-with-path order):
+
+  int8:  [f32 scale (4B)] [n x int8 q]                      -> 4 + n bytes
+  bf16:  [n x bf16 (2B each)]                               -> 2n bytes
+  topk:  [f32 scale (4B)] [k x u32 ascending idx] [k x int8] -> 4 + 5k bytes
+
+Quantization (int8 and topk values): all math in float32. ``scale =
+absmax * fl(1/127)`` (see ``_INV_QMAX`` for why the multiply form is the
+spec); ``q = clip(rint(x * (1/scale)), -127, 127)`` with a zero guard
+(``scale == 0`` maps to all-zero q and decodes to zeros). ``rint`` is
+round-half-to-even in both numpy and XLA, so the reference and device
+encoders agree bitwise. Top-k selection is by magnitude with ties broken
+toward the LOWER index (``np.argsort(kind="stable")`` on the host,
+``lax.top_k`` on device — both lowest-index-first), then indices are stored
+ascending so the buffer is canonical.
+
+Import discipline: this module must import WITHOUT jax (``runtime/lockstep``
+is jax-free on purpose). Everything device-side imports jax lazily inside
+the function body.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+MODES = ("none", "int8", "bf16", "topk")
+# Modes that carry a per-row f32 scale header before the payload.
+_SCALED = ("int8", "topk")
+
+_QMAX = np.float32(127.0)
+# The scale is DEFINED as ``absmax * fl(1/127)`` (one correctly-rounded
+# multiply), not ``absmax / 127``: compilers strength-reduce constant
+# divides into reciprocal multiplies inconsistently (observed: the Pallas
+# interpreter does, XLA:CPU does not — a 1-ULP divergence), so the wire
+# spec pins the multiply form that every backend computes identically.
+_INV_QMAX = np.float32(1.0 / 127.0)
+
+
+def topk_count(n: int, ratio: float) -> int:
+    """Coordinates kept per leaf row under ``topk`` at ``ratio``: at least 1,
+    at most ``n``, else ``ceil(ratio * n)``."""
+    if n <= 0:
+        raise ValueError(f"leaf row has no elements (n={n})")
+    return max(1, min(n, int(math.ceil(float(ratio) * n))))
+
+
+def leaf_nbytes(n: int, mode: str, k: Optional[int] = None) -> int:
+    """Compressed bytes for one leaf row of ``n`` elements."""
+    if mode == "int8":
+        return 4 + n
+    if mode == "bf16":
+        return 2 * n
+    if mode == "topk":
+        if k is None:
+            raise ValueError("topk needs k")
+        return 4 + 5 * k
+    raise ValueError(f"unknown delta codec mode {mode!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafCodec:
+    """Static codec plan for one pytree leaf's per-trainer row."""
+
+    key: str  # jax.tree_util keystr of the leaf path
+    row_shape: tuple  # per-trainer shape (leaf shape minus the peer axis)
+    dtype: str  # original leaf dtype string (decode target)
+    n: int  # elements per row
+    mode: str
+    k: int  # kept coordinates (== n outside topk)
+    offset: int  # byte offset of this segment within the packed row
+    nbytes: int  # compressed bytes of this segment
+
+    def header(self) -> bytes:
+        """Digest domain-separation header. Extends the dense digester's
+        ``key|shape|dtype`` framing with the codec parameters so a dense and
+        a compressed digest can never collide even at equal byte widths."""
+        return (
+            self.key.encode()
+            + str(tuple(self.row_shape)).encode()
+            + self.dtype.encode()
+            + f"|codec={self.mode}|k={self.k}|n={self.n}".encode()
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class CodecLayout:
+    """Whole-row codec plan: one ``LeafCodec`` per pytree leaf, in pack order."""
+
+    mode: str
+    ratio: float
+    leaves: tuple
+    total_bytes: int
+
+    def digest_segments(self) -> list:
+        """``(header_bytes, nbytes)`` pairs for
+        ``crypto.make_segment_digester`` — the compressed row's digest
+        framing, mirroring the dense digester's per-leaf segments."""
+        return [(leaf.header(), leaf.nbytes) for leaf in self.leaves]
+
+
+def build_layout(
+    leaf_meta: Sequence[tuple], mode: str, ratio: float
+) -> CodecLayout:
+    """Layout from ``(keystr, row_shape, dtype_str)`` triples (tree order).
+
+    Pure host math — usable without jax. ``ratio`` only matters for topk.
+    """
+    if mode not in MODES or mode == "none":
+        raise ValueError(f"cannot build a codec layout for mode {mode!r}")
+    leaves = []
+    offset = 0
+    for key, row_shape, dtype_str in leaf_meta:
+        n = int(np.prod(row_shape, dtype=np.int64)) if row_shape else 1
+        k = topk_count(n, ratio) if mode == "topk" else n
+        nbytes = leaf_nbytes(n, mode, k)
+        leaves.append(
+            LeafCodec(
+                key=str(key),
+                row_shape=tuple(row_shape),
+                dtype=str(dtype_str),
+                n=n,
+                mode=mode,
+                k=k,
+                offset=offset,
+                nbytes=nbytes,
+            )
+        )
+        offset += nbytes
+    return CodecLayout(mode=mode, ratio=float(ratio), leaves=tuple(leaves), total_bytes=offset)
+
+
+def layout_from_tree(delta: Any, mode: str, ratio: float) -> CodecLayout:
+    """Layout for a stacked delta pytree (leaves ``[num_peers, ...]``; the
+    leading axis is the peer axis and is dropped from the row shape).
+
+    The only function here that needs jax — imported lazily.
+    """
+    import jax
+
+    leaves_with_path = jax.tree_util.tree_flatten_with_path(delta)[0]
+    meta = [
+        (jax.tree_util.keystr(path), tuple(leaf.shape[1:]), str(leaf.dtype))
+        for path, leaf in leaves_with_path
+    ]
+    return build_layout(meta, mode, ratio)
+
+
+# ---------------------------------------------------------------------------
+# bf16 bit conversion (numpy reference; round-to-nearest-even, matching XLA's
+# f32->bf16 convert so the host and device encoders agree bitwise).
+# ---------------------------------------------------------------------------
+
+
+def _f32_to_bf16_bits(x: np.ndarray) -> np.ndarray:
+    u = np.ascontiguousarray(x, dtype="<f4").view(np.uint32)
+    bias = np.uint32(0x7FFF) + ((u >> np.uint32(16)) & np.uint32(1))
+    return ((u + bias) >> np.uint32(16)).astype("<u2")
+
+
+def _bf16_bits_to_f32(bits: np.ndarray) -> np.ndarray:
+    return (bits.astype(np.uint32) << np.uint32(16)).view("<f4")
+
+
+# ---------------------------------------------------------------------------
+# Numpy reference codec. All encoders take/return 2-D [T, n] arrays.
+# ---------------------------------------------------------------------------
+
+
+def _quantize_np(x: np.ndarray) -> tuple:
+    """Row-wise symmetric int8 quantization in f32: (q int8 [T,n], scale f32 [T])."""
+    xf = np.asarray(x, dtype=np.float32)
+    absmax = np.max(np.abs(xf), axis=-1)
+    scale = (absmax * _INV_QMAX).astype(np.float32)
+    inv = _inv_scale_np(scale)
+    q = np.clip(np.rint(xf * inv[:, None]), -127.0, 127.0).astype(np.int8)
+    return q, scale
+
+
+def _inv_scale_np(scale: np.ndarray) -> np.ndarray:
+    return np.divide(
+        np.float32(1.0),
+        scale,
+        out=np.zeros_like(scale, dtype=np.float32),
+        where=scale > 0,
+    )
+
+
+def _topk_select_np(x: np.ndarray, k: int) -> tuple:
+    """(idx u32 [T,k] ascending, vals f32 [T,k]); ties -> lower index."""
+    xf = np.asarray(x, dtype=np.float32)
+    mags = np.abs(xf)
+    order = np.argsort(-mags, axis=-1, kind="stable")[:, :k]
+    idx = np.sort(order, axis=-1).astype(np.uint32)
+    vals = np.take_along_axis(xf, idx.astype(np.int64), axis=-1)
+    return idx, vals
+
+
+def encode_np(x: np.ndarray, mode: str, k: Optional[int] = None) -> np.ndarray:
+    """Reference encoder: [T, n] floats -> [T, leaf_nbytes] uint8."""
+    xf = np.ascontiguousarray(x, dtype=np.float32)
+    if xf.ndim != 2:
+        raise ValueError(f"encode_np wants [T, n], got shape {x.shape}")
+    t, n = xf.shape
+    if mode == "bf16":
+        return _f32_to_bf16_bits(xf).reshape(t, n).view(np.uint8).reshape(t, 2 * n)
+    if mode == "int8":
+        q, scale = _quantize_np(xf)
+        out = np.empty((t, 4 + n), dtype=np.uint8)
+        out[:, :4] = scale.astype("<f4").view(np.uint8).reshape(t, 4)
+        out[:, 4:] = q.view(np.uint8)
+        return out
+    if mode == "topk":
+        if k is None:
+            raise ValueError("topk needs k")
+        idx, vals = _topk_select_np(xf, k)
+        absmax = np.max(np.abs(xf), axis=-1)
+        scale = (absmax * _INV_QMAX).astype(np.float32)
+        inv = _inv_scale_np(scale)
+        q = np.clip(np.rint(vals * inv[:, None]), -127.0, 127.0).astype(np.int8)
+        out = np.empty((t, 4 + 5 * k), dtype=np.uint8)
+        out[:, :4] = scale.astype("<f4").view(np.uint8).reshape(t, 4)
+        out[:, 4 : 4 + 4 * k] = (
+            np.ascontiguousarray(idx, dtype="<u4").view(np.uint8).reshape(t, 4 * k)
+        )
+        out[:, 4 + 4 * k :] = q.view(np.uint8)
+        return out
+    raise ValueError(f"unknown delta codec mode {mode!r}")
+
+
+def decode_np(
+    buf: np.ndarray, n: int, mode: str, k: Optional[int] = None
+) -> np.ndarray:
+    """Decode one leaf segment: [T, leaf_nbytes] uint8 -> [T, n] f32.
+
+    Wire-robustness contract: every size and index that arrives on the wire
+    is validated BEFORE it sizes an allocation or a scatter — the buffer
+    width must match the static layout exactly, and topk indices must be
+    strictly ascending and < n. A peer cannot amplify memory by lying about
+    k or the length header; those are layout constants, not wire fields.
+    """
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    if buf.ndim != 2:
+        raise ValueError(f"decode_np wants [T, nbytes], got shape {buf.shape}")
+    expected = leaf_nbytes(n, mode, k)
+    if buf.shape[1] != expected:
+        raise ValueError(
+            f"compressed segment width {buf.shape[1]} != expected {expected} "
+            f"for mode={mode} n={n} k={k}"
+        )
+    t = buf.shape[0]
+    if mode == "bf16":
+        bits = buf.reshape(t, n, 2).copy().view("<u2").reshape(t, n)
+        return _bf16_bits_to_f32(bits).astype(np.float32)
+    if mode == "int8":
+        scale = buf[:, :4].copy().view("<f4").reshape(t)
+        q = buf[:, 4:].view(np.int8)
+        return (q.astype(np.float32) * scale[:, None]).astype(np.float32)
+    if mode == "topk":
+        scale = buf[:, :4].copy().view("<f4").reshape(t)
+        idx = buf[:, 4 : 4 + 4 * k].copy().view("<u4").reshape(t, k)
+        q = buf[:, 4 + 4 * k :].view(np.int8)
+        if idx.size and int(idx.max()) >= n:
+            raise ValueError(
+                f"topk index {int(idx.max())} out of range for leaf of {n} elements"
+            )
+        if k > 1 and not bool(np.all(idx[:, 1:] > idx[:, :-1])):
+            raise ValueError("topk indices are not strictly ascending")
+        out = np.zeros((t, n), dtype=np.float32)
+        np.put_along_axis(
+            out, idx.astype(np.int64), q.astype(np.float32) * scale[:, None], axis=-1
+        )
+        return out
+    raise ValueError(f"unknown delta codec mode {mode!r}")
+
+
+def roundtrip_np(x: np.ndarray, mode: str, k: Optional[int] = None) -> np.ndarray:
+    """encode -> decode, f32 out. The receiver-visible value of ``x``."""
+    n = int(np.asarray(x).shape[-1])
+    return decode_np(encode_np(x, mode, k), n, mode, k)
+
+
+def ef_step_np(
+    delta: np.ndarray, err: np.ndarray, mode: str, k: Optional[int] = None
+) -> tuple:
+    """One error-feedback step on the host reference path:
+    ship ``roundtrip(delta + err)``, carry the residual forward."""
+    v = np.asarray(delta, dtype=np.float32) + np.asarray(err, dtype=np.float32)
+    shipped = roundtrip_np(v, mode, k)
+    return shipped, (v - shipped).astype(np.float32)
+
+
+def decode_row_np(row: np.ndarray, layout: CodecLayout) -> dict:
+    """Decode one packed row (all leaves) into ``{keystr: f32 row array}``."""
+    row = np.ascontiguousarray(row, dtype=np.uint8).reshape(-1)
+    if row.size != layout.total_bytes:
+        raise ValueError(
+            f"packed row is {row.size} bytes, layout wants {layout.total_bytes}"
+        )
+    out = {}
+    for leaf in layout.leaves:
+        seg = row[leaf.offset : leaf.offset + leaf.nbytes].reshape(1, leaf.nbytes)
+        flat = decode_np(seg, leaf.n, leaf.mode, leaf.k)[0]
+        out[leaf.key] = flat.reshape(leaf.row_shape)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# jax encoders (lazy imports; traceable with static mode/k).
+# ---------------------------------------------------------------------------
+
+
+def quantize_jax(x: Any) -> tuple:
+    """Row-wise symmetric int8 quantization: (q int8 [..., n], scale f32 [...]).
+
+    Bitwise-identical to ``_quantize_np`` on CPU (f32 math, rint half-even).
+    """
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = absmax * _INV_QMAX
+    inv = jnp.where(scale > 0, jnp.float32(1.0) / scale, jnp.float32(0.0))
+    q = jnp.clip(jnp.rint(xf * inv[..., None]), -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def _bytes_of(x: Any) -> Any:
+    """Bitcast any fixed-width array [..., n] to uint8 [..., n*itemsize]."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if x.dtype == jnp.uint8:
+        return x
+    b = lax.bitcast_convert_type(x, jnp.uint8)  # [..., n, itemsize]
+    return b.reshape(*x.shape[:-1], -1)
+
+
+def encode_jax(x: Any, mode: str, k: Optional[int] = None) -> Any:
+    """Device encoder: [T, n] floats -> [T, leaf_nbytes] uint8.
+
+    Pure jnp/lax (shard_map- and jit-safe; ``mode``/``k`` static). The fused
+    Pallas path in ``ops/pallas_codec`` replaces only the quantize step; the
+    byte packing below is shared.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    xf = x.astype(jnp.float32)
+    t, n = xf.shape
+    if mode == "bf16":
+        bits = lax.bitcast_convert_type(xf.astype(jnp.bfloat16), jnp.uint16)
+        return _bytes_of(bits)
+    if mode == "int8":
+        q, scale = quantize_jax(xf)
+        return jnp.concatenate([_bytes_of(scale[:, None]), _bytes_of(q)], axis=1)
+    if mode == "topk":
+        if k is None:
+            raise ValueError("topk needs k")
+        mags = jnp.abs(xf)
+        _, raw_idx = lax.top_k(mags, k)  # ties -> lower index, like the reference
+        idx = jnp.sort(raw_idx, axis=-1)
+        vals = jnp.take_along_axis(xf, idx, axis=-1)
+        absmax = jnp.max(mags, axis=-1)
+        scale = absmax * _INV_QMAX
+        inv = jnp.where(scale > 0, jnp.float32(1.0) / scale, jnp.float32(0.0))
+        q = jnp.clip(jnp.rint(vals * inv[:, None]), -127.0, 127.0).astype(jnp.int8)
+        return jnp.concatenate(
+            [
+                _bytes_of(scale[:, None]),
+                _bytes_of(idx.astype(jnp.uint32)),
+                _bytes_of(q),
+            ],
+            axis=1,
+        )
+    raise ValueError(f"unknown delta codec mode {mode!r}")
+
+
+def roundtrip_jax(x: Any, mode: str, k: Optional[int] = None) -> Any:
+    """Receiver-visible value of ``x`` on device, cast back to ``x.dtype``.
+
+    Skips the byte shuffle: mathematically identical to encode->decode
+    because quantize/dequantize round-trips exactly through the bitcast.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    xf = x.astype(jnp.float32)
+    if mode == "bf16":
+        out = xf.astype(jnp.bfloat16).astype(jnp.float32)
+    elif mode == "int8":
+        q, scale = quantize_jax(xf)
+        out = q.astype(jnp.float32) * scale[..., None]
+    elif mode == "topk":
+        if k is None:
+            raise ValueError("topk needs k")
+        mags = jnp.abs(xf)
+        _, raw_idx = lax.top_k(mags, k)
+        idx = jnp.sort(raw_idx, axis=-1)
+        vals = jnp.take_along_axis(xf, idx, axis=-1)
+        absmax = jnp.max(mags, axis=-1)
+        scale = absmax * _INV_QMAX
+        inv = jnp.where(scale > 0, jnp.float32(1.0) / scale, jnp.float32(0.0))
+        q = jnp.clip(jnp.rint(vals * inv[..., None]), -127.0, 127.0).astype(jnp.int8)
+        deq = q.astype(jnp.float32) * scale[..., None]
+        out = jnp.zeros_like(xf).at[
+            jnp.arange(xf.shape[0])[:, None], idx
+        ].set(deq)
+    else:
+        raise ValueError(f"unknown delta codec mode {mode!r}")
+    return out.astype(x.dtype)

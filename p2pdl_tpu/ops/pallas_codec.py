@@ -1,0 +1,193 @@
+"""Fused int8 quantize(+pack) Pallas TPU kernel for the compressed-delta
+wire format.
+
+The XLA encode path (``delta_codec.encode_jax``) lowers the row-wise
+symmetric quantization as separate HLOs: an ``[T, D]`` abs, a full-row max
+reduction, and an elementwise scale-multiply/round/clip — the big ``[T, D]``
+leaf matrices make three HBM round-trips before the int8 bytes exist. This
+kernel fuses the whole quantizer: feature blocks stream through VMEM once,
+the per-row absmax accumulates in the revisited scales output block (the
+same in-VMEM-accumulator trick as ``pallas_aggregators._gram_kernel``), and
+a second grid phase rewrites the accumulator into ``absmax/127`` scales and
+emits the int8 blocks — each element of ``x`` is read from HBM exactly
+twice (once per phase) and the only other traffic is the int8 result at a
+quarter of the input bytes.
+
+Numerics are pinned to the reference encoder bit for bit: all math in
+float32, ``scale = absmax/127`` with a zero guard, ``rint`` (half-to-even)
+then clip to ±127 — tests compare interpret-mode output against
+``delta_codec.encode_np`` bytewise.
+
+Routing matches ``pallas_aggregators``: Mosaic-compiled on TPU, the XLA
+encoder elsewhere; on ``jax_compat``-shimmed builds the kernel is not
+trusted at all and ``use_fused()`` is False. ``_FORCE_INTERPRET`` lets CPU
+tier-1 exercise the flag-gated pack path end-to-end in the interpreter.
+The pack step runs OUTSIDE ``shard_map`` (on the gathered ``[T, ...]``
+trainer rows, same as ``build_digest_pack_fn``), so interpret mode is safe
+here in a way it is not for the in-shard reducers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:  # capability probe, not a hard dependency (old builds lack pieces)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_IMPORTED = True
+except Exception:  # pragma: no cover - import-time environment probe
+    pl = None
+    pltpu = None
+    _PALLAS_IMPORTED = False
+
+_COMPILER_PARAMS = (
+    getattr(pltpu, "CompilerParams", None) or getattr(pltpu, "TPUCompilerParams", None)
+    if _PALLAS_IMPORTED
+    else None
+)
+
+
+def _sds(shape, dtype, vma):
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # pre-vma build: no replication typing to satisfy
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# int8 sublane tile is (32, 128): pad T to a multiple of 32 so the q output
+# tiles cleanly (f32 only needs 8; 32 covers both outputs).
+_SUBLANES = 32
+
+# Feature-block width streamed through VMEM per grid step (lane-aligned).
+_DEFAULT_BLOCK_D = 512
+
+# Same off-TPU test hook as pallas_aggregators._FORCE_INTERPRET: makes
+# use_fused() report True and every launch run in the interpreter, so CPU
+# tier-1 can pin the flag-gated compressed-pack path, not just the kernel.
+_FORCE_INTERPRET = False
+
+
+def available() -> bool:
+    """Kernel path trusted on this JAX build (pallas imports and no
+    ``jax_compat`` shims — same capability gate as ``pallas_aggregators``)."""
+    from p2pdl_tpu.utils import jax_compat
+
+    return _PALLAS_IMPORTED and not jax_compat.active()
+
+
+def use_fused() -> bool:
+    """True when the flag-gated pack path should take the kernel."""
+    return available() and (_on_tpu() or _FORCE_INTERPRET)
+
+
+def _on_tpu() -> bool:
+    dev = jax.devices()[0]
+    return "tpu" in dev.platform.lower() or "tpu" in dev.device_kind.lower()
+
+
+def _vma(x) -> frozenset:
+    try:
+        return frozenset(jax.typeof(x).vma)
+    except Exception:  # non-traced input or backend without vma support
+        return frozenset()
+
+
+def _quantize_kernel(x_ref, q_ref, s_ref, *, nj):
+    """Grid ``(2, n_feature_blocks)``, sequential row-major. Refs: x
+    ``[t_pad, block_d]`` f32 (block j); q ``[t_pad, block_d]`` int8 (block
+    j); s ``[t_pad, 128]`` f32 — block (0, 0) on every step, so it persists
+    in VMEM as the absmax accumulator through phase 0 and holds the
+    broadcast scales after phase 1's first step.
+
+    Phase 0 (p=0, j sweeps): fold block j's per-row absmax into s via a
+    lane-shaped partial max (``[t_pad, block_d] -> [t_pad, 128]``).
+    Phase 1 (p=1, j sweeps): on j=0 collapse s across lanes into the final
+    per-row scale (``absmax/127``, broadcast back over the 128 lanes);
+    every j then quantizes its block against s. The q block at (p=0, j) is
+    never written — its phase-1 visit overwrites the whole block."""
+    p = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when((p == 0) & (j == 0))
+    def _():
+        s_ref[...] = jnp.zeros_like(s_ref)
+
+    @pl.when(p == 0)
+    def _():
+        xb = jnp.abs(x_ref[...])  # [t_pad, block_d] f32
+        t_pad, block_d = xb.shape
+        part = jnp.max(xb.reshape(t_pad, block_d // 128, 128), axis=1)
+        s_ref[...] = jnp.maximum(s_ref[...], part)
+
+    @pl.when((p == 1) & (j == 0))
+    def _():
+        absmax = jnp.max(s_ref[...], axis=1, keepdims=True)  # [t_pad, 1]
+        s_ref[...] = jnp.broadcast_to(absmax / 127.0, s_ref.shape)
+
+    @pl.when(p == 1)
+    def _():
+        scale = s_ref[...][:, :1]  # [t_pad, 1], identical across lanes
+        inv = jnp.where(scale > 0, jnp.float32(1.0) / scale, jnp.float32(0.0))
+        q = jnp.clip(jnp.rint(x_ref[...] * inv), -127.0, 127.0)
+        q_ref[...] = q.astype(jnp.int8)
+
+    del nj
+
+
+def fused_quantize_int8(
+    x: jnp.ndarray, *, block_d: int | None = None, interpret: bool = False
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Row-wise symmetric int8 quantization of ``x`` ``[T, D]`` in one fused
+    kernel: returns ``(q int8 [T, D], scale f32 [T])`` with ``scale =
+    absmax/127`` — bitwise the reference ``delta_codec.quantize_jax``.
+
+    Callers gate on :func:`use_fused`; ``interpret=True`` runs the same
+    kernel in the Pallas interpreter for the CPU equivalence tests."""
+    t, d = x.shape
+    x = x.astype(jnp.float32)
+    block_d = int(block_d or _DEFAULT_BLOCK_D)
+    t_pad = -(-t // _SUBLANES) * _SUBLANES
+    block_d = min(block_d, -(-d // 128) * 128)
+    d_pad = -(-d // block_d) * block_d
+    xp = jnp.pad(x, ((0, t_pad - t), (0, d_pad - d)))
+    nj = d_pad // block_d
+
+    kernel = functools.partial(_quantize_kernel, nj=nj)
+    q, s = pl.pallas_call(
+        kernel,
+        grid=(2, nj),
+        in_specs=[pl.BlockSpec((t_pad, block_d), lambda p, j: (0, j))],
+        out_specs=[
+            pl.BlockSpec((t_pad, block_d), lambda p, j: (0, j)),
+            pl.BlockSpec((t_pad, 128), lambda p, j: (0, 0)),
+        ],
+        out_shape=[
+            _sds((t_pad, d_pad), jnp.int8, _vma(x)),
+            _sds((t_pad, 128), jnp.float32, _vma(x)),
+        ],
+        compiler_params=_COMPILER_PARAMS(
+            dimension_semantics=("arbitrary", "arbitrary")
+        ),
+        interpret=bool(interpret or _FORCE_INTERPRET),
+    )(xp)
+    return q[:t, :d], s[:t, 0]
+
+
+def fused_encode_int8(
+    x: jnp.ndarray, *, block_d: int | None = None, interpret: bool = False
+) -> jnp.ndarray:
+    """int8-mode wire segment ``[T, 4 + D]`` uint8 for ``x`` ``[T, D]``:
+    fused quantize, then the same bitcast packing as the XLA encoder (the
+    byte shuffle is pure layout — XLA handles it; the FLOP- and
+    traffic-heavy quantize is what the kernel owns). Bytewise equal to
+    ``delta_codec.encode_np(x, "int8")``."""
+    from jax import lax
+
+    q, scale = fused_quantize_int8(x, block_d=block_d, interpret=interpret)
+    sb = lax.bitcast_convert_type(scale[:, None], jnp.uint8).reshape(x.shape[0], 4)
+    qb = lax.bitcast_convert_type(q, jnp.uint8)
+    return jnp.concatenate([sb, qb], axis=1)

@@ -18,6 +18,7 @@ from p2pdl_tpu.parallel.peer_state import (
     shard_state,
 )
 from p2pdl_tpu.parallel.round import (
+    build_compressed_pack_fn,
     build_digest_pack_fn,
     build_eval_fn,
     build_multi_round_fn,
@@ -37,6 +38,7 @@ __all__ = [
     "shard_state",
     "global_params",
     "params_layout",
+    "build_compressed_pack_fn",
     "build_digest_pack_fn",
     "build_round_fn",
     "build_multi_round_fn",

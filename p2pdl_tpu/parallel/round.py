@@ -1168,6 +1168,58 @@ def build_digest_pack_fn(delta) -> tuple[Callable, Callable]:
     return telemetry.traced("dispatch.digest_pack", jax.jit(pack)), hash_row
 
 
+def build_compressed_pack_fn(
+    delta, mode: str, ratio: float
+) -> tuple[Callable, Callable]:
+    """Compressed sibling of :func:`build_digest_pack_fn`: one
+    ``[T, compressed_bytes]`` uint8 buffer per round, quantized/sparsified
+    on device per the ``ops.delta_codec`` wire layout.
+
+    Same discipline as the dense pack — exactly one ``jax.device_get`` per
+    round downstream, all shapes static (``mode``/``ratio`` are baked into
+    the program; per-leaf ``k`` comes from the layout), and the vacancy
+    clamp (``-1`` -> row 0) so shrunken rounds never recompile. Returns
+    ``(pack_fn, hash_row)`` shaped exactly like the dense pair so the
+    driver swaps them interchangeably:
+
+    - ``pack_fn(delta, trainer_idx)``: jitted; per leaf gathers the ``[T]``
+      trainer rows, encodes them (int8 quantize routed through the fused
+      Pallas kernel when ``ops.pallas_codec.use_fused()`` — Mosaic on TPU,
+      XLA encoder elsewhere, interpreter under the test hook), and
+      concatenates the wire segments.
+    - ``hash_row(row)``: host-side SHA-256 over one fetched COMPRESSED row
+      (``crypto.make_segment_digester`` over the layout's per-leaf
+      headers+widths) — the digest BRB signs is over the bytes the wire
+      ships, so ``agg_admit`` lineage and ``cli audit`` hold unchanged.
+
+    The returned ``pack_fn`` carries the ``CodecLayout`` as ``.layout``
+    (the receiver side and the byte accounting both need it).
+    """
+    from p2pdl_tpu.ops import delta_codec, pallas_codec
+    from p2pdl_tpu.protocol.crypto import make_segment_digester
+
+    layout = delta_codec.layout_from_tree(delta, mode, ratio)
+    leaves = jax.tree_util.tree_flatten_with_path(delta)[0]
+    num_peers = int(leaves[0][1].shape[0])
+    hash_row = make_segment_digester(layout.digest_segments())
+
+    def pack(delta, trainer_idx):
+        idx = jnp.clip(trainer_idx, 0, num_peers - 1)
+        segs = []
+        for leaf_codec, (_, leaf) in zip(layout.leaves, jax.tree_util.tree_flatten_with_path(delta)[0]):
+            g = jnp.take(leaf, idx, axis=0)
+            flat = g.reshape((g.shape[0], -1))
+            if mode == "int8" and pallas_codec.use_fused():
+                segs.append(pallas_codec.fused_encode_int8(flat))
+            else:
+                segs.append(delta_codec.encode_jax(flat, mode, k=leaf_codec.k))
+        return jnp.concatenate(segs, axis=1)
+
+    pack_fn = telemetry.traced("dispatch.compressed_pack", jax.jit(pack))  # p2plint: disable=donation-discipline -- sanctioned: pack reads a delta the aggregate phase still consumes; donation would free live buffers
+    pack_fn.layout = layout
+    return pack_fn, hash_row
+
+
 def build_gossip_trust_round_fns(
     cfg: Config, mesh: Mesh, attack: str = "none"
 ) -> tuple[Callable, Callable]:
@@ -1479,6 +1531,29 @@ def _aggregate_phase(
         dev = lax.axis_index(PEER_AXIS)
         local_ids = dev * l_per_dev + jnp.arange(l_per_dev)
         is_trainer = jnp.isin(local_ids, trainer_idx)
+
+        if cfg.delta_compression != "none":
+            # Compressed wire semantics: what aggregation consumes is the
+            # codec ROUNDTRIP of each peer's raw delta — bit-identical to
+            # decode(encode(row)) of the bytes build_compressed_pack_fn
+            # ships and BRB signs ("what is signed is what is shipped").
+            # Row-wise per peer, so it composes with the peer sharding;
+            # applied before any other delta transform (Config validation
+            # forbids the combinations that would reorder it).
+            from p2pdl_tpu.ops import delta_codec as _codec
+
+            def _roundtrip(d):
+                flat = d.reshape(l_per_dev, -1)
+                k = (
+                    _codec.topk_count(flat.shape[1], cfg.compress_ratio)
+                    if cfg.delta_compression == "topk"
+                    else None
+                )
+                return _codec.roundtrip_jax(flat, cfg.delta_compression, k).reshape(
+                    d.shape
+                )
+
+            delta = jax.tree.map(_roundtrip, delta)
 
         tau_eff = None
         if cfg.fednova:

@@ -162,6 +162,10 @@ class AsyncTCPTransport:
         self._partition_refused = 0
         self._fault_dropped = 0
         self._inflight = 0
+        # Per-peer cumulative payload bytes (frame minus the src header) —
+        # stats-dict material like queue_depth, never telemetry labels.
+        self._tx_bytes: dict[int, int] = {}
+        self._rx_bytes: dict[int, int] = {}
         self._c_sent = telemetry.counter("transport.messages", transport="aio", event="sent")
         self._c_bytes = telemetry.counter("transport.bytes", transport="aio", event="sent")
         self._c_fail = telemetry.counter("transport.messages", transport="aio", event="send_failed")
@@ -291,6 +295,9 @@ class AsyncTCPTransport:
                     cut = True
                 else:
                     self._delivered += 1
+                    self._rx_bytes[src] = (
+                        self._rx_bytes.get(src, 0) + len(frame) - _LEN.size
+                    )
                     cut = False
             if cut:
                 self._c_partition.inc()
@@ -402,6 +409,7 @@ class AsyncTCPTransport:
                 await send_frame_async(writer, frame)
                 with self._lock:
                     self._sent += 1
+                    self._tx_bytes[dst] = self._tx_bytes.get(dst, 0) + len(data)
                 self._c_sent.inc()
                 self._c_bytes.inc(len(data))
                 return
@@ -525,6 +533,14 @@ class AsyncTCPTransport:
                 "fault_dropped": self._fault_dropped,
                 "high_water": self.high_water,
                 "blocked_peers": sorted(self._blocked),
+                "tx_bytes": sum(self._tx_bytes.values()),
+                "rx_bytes": sum(self._rx_bytes.values()),
+                "tx_bytes_by_peer": {
+                    str(p): b for p, b in sorted(self._tx_bytes.items())
+                },
+                "rx_bytes_by_peer": {
+                    str(p): b for p, b in sorted(self._rx_bytes.items())
+                },
                 "queue_depth": {
                     str(p): len(q) for p, q in sorted(self._queues.items())
                 },

@@ -126,6 +126,42 @@ def digest_update(update) -> bytes:
     return h.digest()
 
 
+def make_segment_digester(segments):
+    """Per-row hasher over VARIABLE-WIDTH byte segments.
+
+    ``segments`` is ``[(header_bytes, nbytes), ...]``: each row is a
+    concatenation of fixed (but per-segment different) widths, and the
+    digest interleaves each segment's header with its bytes — the framing
+    both the dense digest pack (:func:`make_row_digester`, whose segments
+    are ``row_shape x dtype.itemsize``) and the compressed pack (segments
+    are ``ops.delta_codec`` wire widths, headers carry the codec
+    parameters) reduce to. Headers and offsets are precomputed once; per
+    row only SHA-256 runs (which releases the GIL on large buffers, so
+    rows thread-pool well).
+    """
+    spans: list[tuple[bytes, int, int]] = []
+    offset = 0
+    for header, nbytes in segments:
+        spans.append((bytes(header), offset, offset + nbytes))
+        offset += nbytes
+    total = offset
+
+    def hash_row(row) -> bytes:
+        view = memoryview(np.ascontiguousarray(row)).cast("B")
+        if len(view) != total:
+            raise ValueError(
+                f"packed row has {len(view)} bytes, layout expects {total}"
+            )
+        h = hashlib.sha256()
+        for header, start, end in spans:
+            h.update(header)
+            h.update(view[start:end])
+        return h.digest()
+
+    hash_row.total_bytes = total
+    return hash_row
+
+
 def make_row_digester(leaf_meta):
     """Per-row hasher for the single-transfer digest path, bit-compatible
     with :func:`digest_update`.
@@ -139,32 +175,16 @@ def make_row_digester(leaf_meta):
     ``parallel.round.build_digest_pack_fn`` produces) and interleaves the
     canonical per-leaf header bytes — keystr + str(shape) + str(dtype) —
     with the corresponding byte segments, so the digest is bitwise equal
-    to ``digest_update`` of that trainer's slice tree. The header bytes
-    and segment offsets are precomputed once; per row only SHA-256 runs
-    (which releases the GIL on large buffers, so rows thread-pool well).
+    to ``digest_update`` of that trainer's slice tree. A specialization of
+    :func:`make_segment_digester` to dense (shape x itemsize) widths.
     """
-    segments: list[tuple[bytes, int, int]] = []
-    offset = 0
-    for key, row_shape, dtype_str, nbytes in leaf_meta:
-        header = key.encode() + str(tuple(row_shape)).encode() + dtype_str.encode()
-        segments.append((header, offset, offset + nbytes))
-        offset += nbytes
-    total = offset
-
-    def hash_row(row) -> bytes:
-        view = memoryview(np.ascontiguousarray(row)).cast("B")
-        if len(view) != total:
-            raise ValueError(
-                f"packed row has {len(view)} bytes, layout expects {total}"
-            )
-        h = hashlib.sha256()
-        for header, start, end in segments:
-            h.update(header)
-            h.update(view[start:end])
-        return h.digest()
-
-    hash_row.total_bytes = total
-    return hash_row
+    return make_segment_digester(
+        (
+            key.encode() + str(tuple(row_shape)).encode() + dtype_str.encode(),
+            nbytes,
+        )
+        for key, row_shape, dtype_str, nbytes in leaf_meta
+    )
 
 
 def public_key_pem(public_key) -> bytes:

@@ -409,6 +409,14 @@ class TCPTransport:
         # fire-and-forget daemon threads could outlive stop() mid-recv.
         self._conn_lock = threading.Lock()
         self._conns: list[tuple[threading.Thread, socket.socket]] = []
+        # Per-peer cumulative payload bytes (frame minus the src header),
+        # written under _conn_lock — stats-dict material, never telemetry
+        # labels (peer ids are unbounded identity values).
+        self._tx_bytes: dict[int, int] = {}
+        self._rx_bytes: dict[int, int] = {}
+        self._sent = 0
+        self._delivered = 0
+        self._send_failed = 0
         self._c_sent = telemetry.counter("transport.messages", transport="tcp", event="sent")
         self._c_bytes = telemetry.counter("transport.bytes", transport="tcp", event="sent")
         self._c_fail = telemetry.counter("transport.messages", transport="tcp", event="send_failed")
@@ -461,6 +469,11 @@ class TCPTransport:
                     self._c_reject.inc()  # malformed/truncated frame
                 return
             (src,) = _LEN.unpack(frame[: _LEN.size])
+            with self._conn_lock:
+                self._delivered += 1
+                self._rx_bytes[src] = (
+                    self._rx_bytes.get(src, 0) + len(frame) - _LEN.size
+                )
             self._c_deliver.inc()
             self._c_bytes_deliver.inc(len(frame) - _LEN.size)
             self.handler(src, frame[_LEN.size :])
@@ -485,6 +498,9 @@ class TCPTransport:
             try:
                 with socket.create_connection(addr, timeout=self.send_timeout_s) as s:
                     send_frame(s, _LEN.pack(self.my_id) + data)
+                with self._conn_lock:
+                    self._sent += 1
+                    self._tx_bytes[dst] = self._tx_bytes.get(dst, 0) + len(data)
                 self._c_sent.inc()
                 self._c_bytes.inc(len(data))
                 return True
@@ -495,8 +511,30 @@ class TCPTransport:
                 h = hashlib.sha256(f"{self.my_id}|{dst}|{attempt}".encode()).digest()
                 time.sleep(backoff * (1.0 + h[0] / 255.0 * 0.5))
                 backoff *= 2.0
+        with self._conn_lock:
+            self._send_failed += 1
         self._c_fail.inc()
         return False
+
+    def transport_stats(self) -> dict:
+        """JSON-ready snapshot mirroring ``AsyncTCPTransport.transport_stats``
+        (the subset this one-shot transport can observe). Per-peer byte
+        totals live here — a stats dict, never telemetry labels."""
+        with self._conn_lock:
+            return {
+                "transport": "tcp",
+                "sent": self._sent,
+                "delivered": self._delivered,
+                "send_failed": self._send_failed,
+                "tx_bytes": sum(self._tx_bytes.values()),
+                "rx_bytes": sum(self._rx_bytes.values()),
+                "tx_bytes_by_peer": {
+                    str(p): b for p, b in sorted(self._tx_bytes.items())
+                },
+                "rx_bytes_by_peer": {
+                    str(p): b for p, b in sorted(self._rx_bytes.items())
+                },
+            }
 
     def stop(self) -> None:
         """Idempotent shutdown: close the listener, join the accept loop,

@@ -36,6 +36,7 @@ import numpy as np
 from p2pdl_tpu.config import Config
 from p2pdl_tpu.data import make_federated_data
 from p2pdl_tpu.parallel import (
+    build_compressed_pack_fn,
     build_digest_pack_fn,
     build_eval_fn,
     build_round_fn,
@@ -772,7 +773,22 @@ class Experiment:
         if padded is None:
             padded = live
         if self._digest_pack is None:
-            self._digest_pack = build_digest_pack_fn(delta)
+            # Wire-format routing: under delta_compression the pack emits
+            # the COMPRESSED [T, compressed_bytes] buffer and hash_row
+            # digests those wire bytes — BRB signs what ships, the
+            # aggregate phase consumes the codec roundtrip of the same
+            # rows, and everything downstream (agg_admit lineage, cli
+            # audit, tower causal digests) carries the compressed digests
+            # with zero protocol changes. Same (pack_fn, hash_row) shape,
+            # same sentinel registration, same one-D2H-per-round.
+            if self.cfg.delta_compression != "none":
+                self._digest_pack = build_compressed_pack_fn(
+                    delta,
+                    self.cfg.delta_compression,
+                    self.cfg.compress_ratio,
+                )
+            else:
+                self._digest_pack = build_digest_pack_fn(delta)
             self.sentinel.register(
                 getattr(self._digest_pack[0], "program_name", "digest_pack"),
                 self._digest_pack[0],

@@ -79,12 +79,24 @@ class ChaosSpec:
     # Flight ring capacity — identical in every deployment, or ring
     # eviction alone would split the determinism digests.
     capacity: int = 65536
+    # What the BRB broadcast carries: "digest" is the tiny JSON marker the
+    # original scenarios ship; "compressed" runs a deterministic pseudo-delta
+    # keyed (seed, round, trainer) through the topk+int8 wire codec
+    # (``ops.delta_codec``) and broadcasts the digest of the COMPRESSED
+    # bytes — the lockstep pin that the compressed wire format is
+    # deployment-independent.
+    payload_mode: str = "digest"
 
     def __post_init__(self) -> None:
         if self.num_peers % self.num_hosts != 0:
             raise ValueError(
                 f"num_peers ({self.num_peers}) must divide evenly over "
                 f"num_hosts ({self.num_hosts})"
+            )
+        if self.payload_mode not in ("digest", "compressed"):
+            raise ValueError(
+                f"payload_mode must be 'digest' or 'compressed', "
+                f"got {self.payload_mode!r}"
             )
 
     @property
@@ -107,6 +119,31 @@ class ChaosSpec:
         if isinstance(d.get("plan"), dict):
             d["plan"] = FaultPlan.from_dict(d["plan"])
         return cls(**d)
+
+
+def _delta_codec():
+    """Load ``ops.delta_codec`` without executing the ``ops`` package
+    __init__ (which drags in jax via the reducers) — the codec module is
+    numpy-first by contract, so compressed-payload chaos runs stay as
+    jax-free as the digest ones."""
+    import importlib.util
+    import os
+    import sys
+
+    name = "p2pdl_tpu.ops.delta_codec"
+    mod = sys.modules.get(name)
+    if mod is not None:
+        return mod
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ops",
+        "delta_codec.py",
+    )
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _frame_key(fr: dict) -> tuple[int, int, int, int]:
@@ -179,9 +216,30 @@ class LockstepHost:
         return sorted(ranked[: self.spec.trainers_per_round])
 
     def _payload(self, r: int, trainer: int) -> bytes:
+        body = {"round": r, "trainer": trainer, "seed": self.spec.seed}
+        if self.spec.payload_mode == "compressed":
+            import numpy as np
+
+            dc = _delta_codec()
+            # Deterministic pseudo-delta: a SHA-256 counter stream keyed
+            # (seed, round, trainer), mapped into [-1, 1) f32 — pure data,
+            # identical on every host and deployment.
+            n = 4096
+            raw = b"".join(
+                hashlib.sha256(
+                    f"chaos-delta|{self.spec.seed}|{r}|{trainer}|{i}".encode()
+                ).digest()
+                for i in range((n * 4 + 31) // 32)
+            )
+            x = np.frombuffer(raw[: n * 4], dtype="<u4").astype(np.float32)
+            x = x * np.float32(2.0 / 2**32) - np.float32(1.0)
+            k = dc.topk_count(n, 0.01)
+            buf = dc.encode_np(x[None, :], "topk", k)
+            body["codec"] = "topk+int8"
+            body["nbytes"] = int(buf.shape[1])
+            body["digest"] = hashlib.sha256(buf.tobytes()).hexdigest()
         return json.dumps(
-            {"round": r, "trainer": trainer, "seed": self.spec.seed},
-            sort_keys=True, separators=(",", ":"),
+            body, sort_keys=True, separators=(",", ":")
         ).encode()
 
     # -- frame-boundary fate fan-out ------------------------------------

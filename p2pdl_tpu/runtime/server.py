@@ -180,6 +180,8 @@ def _transport_health(snap: dict) -> dict:
         "sent": total("transport.messages", "sent"),
         "delivered": total("transport.messages", "delivered"),
         "send_failed": total("transport.messages", "send_failed"),
+        "tx_bytes": total("transport.bytes", "sent"),
+        "rx_bytes": total("transport.bytes", "delivered"),
         "rejected": total("transport.messages", "rejected"),
         "backpressure_dropped": total("transport.backpressure_dropped"),
     }

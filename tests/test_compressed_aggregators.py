@@ -1,0 +1,214 @@
+"""Compressed-domain robust reducers vs the dense oracles.
+
+Every test feeds BOTH paths the same receiver-visible rows: quantize once
+with the wire codec's reference quantizer, hand the dense reducer the
+dequantized rows ``u = s * q`` and the compressed reducer the raw
+``(q, scales)`` — so any disagreement is a reducer bug, never quantization
+noise. Selection-type reducers (krum) must agree EXACTLY; iterative
+Gram-space reducers (centered clip, centered Gram) carry
+``PATH_TOLERANCE_ATOL_COMPRESSED`` per the tolerance contract in
+``ops/aggregators.py``.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pdl_tpu.ops import aggregators as agg
+from p2pdl_tpu.ops import compressed_aggregators as cagg
+from p2pdl_tpu.ops import delta_codec as dc
+from p2pdl_tpu.ops.aggregators import (
+    PATH_TOLERANCE_ATOL,
+    PATH_TOLERANCE_ATOL_COMPRESSED,
+)
+
+T, N, F = 9, 256, 3  # T >= 2f+3
+
+
+def _quantized(t=T, n=N, seed=0, dup=None, bf16=False):
+    """(q int8 [t,n], scales f32 [t], u f32 [t,n]) from random deltas.
+
+    ``dup=(i, j)`` copies row i over row j first — the vacancy-clamp shape
+    (a clamped slot re-ships a valid trainer's row). ``bf16`` runs the
+    delta through bfloat16 first, the compute-dtype path.
+    """
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(t, n)).astype(np.float32)
+    if dup is not None:
+        x[dup[1]] = x[dup[0]]
+    if bf16:
+        x = np.asarray(jnp.asarray(x).astype(jnp.bfloat16).astype(jnp.float32))
+    q, scales = dc._quantize_np(x)
+    u = q.astype(np.float32) * scales[:, None]
+    return jnp.asarray(q), jnp.asarray(scales), jnp.asarray(u)
+
+
+# ------------------------------------------------------------------ bridges
+
+
+@pytest.mark.parametrize("bf16", [False, True])
+def test_dequantize_is_the_dense_bridge(bf16):
+    q, s, u = _quantized(bf16=bf16)
+    np.testing.assert_array_equal(np.asarray(cagg.dequantize(q, s)), np.asarray(u))
+
+
+def test_densify_topk_matches_wire_decode():
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(5, 64)).astype(np.float32)
+    k = 6
+    buf = dc.encode_np(x, "topk", k)
+    idx = buf[:, 4 : 4 + 4 * k].copy().view("<u4").reshape(5, k)
+    qv = buf[:, 4 + 4 * k :].view(np.int8)
+    scales = buf[:, :4].copy().view("<f4").reshape(5)
+    dense = cagg.densify_topk(
+        jnp.asarray(idx.astype(np.int32)), jnp.asarray(qv), jnp.asarray(scales), 64
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dense), dc.decode_np(buf, 64, "topk", k)
+    )
+
+
+# ------------------------------------------------------------------ fedavg
+
+
+@pytest.mark.parametrize("bf16", [False, True])
+@pytest.mark.parametrize("weighted", [False, True])
+def test_fedavg_int8_matches_dense_fedavg(weighted, bf16):
+    q, s, u = _quantized(seed=1, bf16=bf16)
+    w = jnp.asarray(np.arange(1, T + 1, dtype=np.float32)) if weighted else None
+    got = cagg.fedavg_int8(q, s, weights=w)
+    want = agg.fedavg(u, weights=w)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=PATH_TOLERANCE_ATOL, rtol=0
+    )
+
+
+@pytest.mark.parametrize("weighted", [False, True])
+def test_fedavg_topk_matches_dense_on_densified(weighted):
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(T, N)).astype(np.float32)
+    k = dc.topk_count(N, 0.05)
+    buf = dc.encode_np(x, "topk", k)
+    idx = jnp.asarray(buf[:, 4 : 4 + 4 * k].copy().view("<u4").reshape(T, k).astype(np.int32))
+    qv = jnp.asarray(buf[:, 4 + 4 * k :].view(np.int8))
+    scales = jnp.asarray(buf[:, :4].copy().view("<f4").reshape(T))
+    got = cagg.fedavg_topk(idx, qv, scales, N, weights=None if not weighted else jnp.arange(1.0, T + 1.0))
+    dense_rows = cagg.densify_topk(idx, qv, scales, N)
+    want = agg.fedavg(dense_rows, weights=None if not weighted else jnp.arange(1.0, T + 1.0))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=PATH_TOLERANCE_ATOL, rtol=0
+    )
+
+
+def test_fedavg_int8_with_duplicated_clamped_row():
+    """Vacancy clamp duplicates a valid row; both paths must agree on the
+    duplicated batch exactly like on a distinct one."""
+    q, s, u = _quantized(seed=3, dup=(0, T - 1))
+    np.testing.assert_allclose(
+        np.asarray(cagg.fedavg_int8(q, s)),
+        np.asarray(agg.fedavg(u)),
+        atol=PATH_TOLERANCE_ATOL,
+        rtol=0,
+    )
+
+
+# ------------------------------------------------------------------ gram
+
+
+def test_gram_uncentered_matches_dense_gram():
+    q, s, u = _quantized(seed=4)
+    got = np.asarray(cagg.gram_compressed(q, s, center=False))
+    want = np.asarray(u) @ np.asarray(u).T
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
+def test_gram_centered_matches_centered_rows_gram():
+    q, s, u = _quantized(seed=5)
+    un = np.asarray(u)
+    c = un - un.mean(axis=0, keepdims=True)
+    want = c @ c.T
+    got = np.asarray(cagg.gram_compressed(q, s, center=True))
+    scale = max(1.0, float(np.abs(want).max()))
+    assert np.abs(got - want).max() / scale < PATH_TOLERANCE_ATOL_COMPRESSED
+
+
+def test_pairwise_dists_match_dense():
+    q, s, u = _quantized(seed=6)
+    got = np.asarray(cagg.pairwise_sq_dists_compressed(q, s))
+    want = np.asarray(agg.pairwise_sq_dists(u))
+    scale = max(1.0, float(want.max()))
+    assert np.abs(got - want).max() / scale < PATH_TOLERANCE_ATOL_COMPRESSED
+
+
+# ------------------------------------------------------------------ krum
+
+
+@pytest.mark.parametrize("bf16", [False, True])
+def test_krum_selects_identical_winner(bf16):
+    q, s, u = _quantized(seed=8, bf16=bf16)
+    got = np.asarray(cagg.krum_compressed(q, s, F))
+    best = int(np.argmin(np.asarray(agg.krum_scores(u, F))))
+    np.testing.assert_array_equal(got, np.asarray(u)[best])
+
+
+def test_krum_scores_track_dense_scores():
+    q, s, u = _quantized(seed=9)
+    got = np.asarray(cagg.krum_scores_compressed(q, s, F))
+    want = np.asarray(agg.krum_scores(u, F))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-2)
+
+
+def test_krum_with_outlier_rows_rejects_them():
+    rng = np.random.default_rng(10)
+    x = rng.normal(size=(T, N)).astype(np.float32)
+    x[0] += 40.0
+    x[1] -= 40.0  # two wild rows; winner must be an inlier
+    q, scales = dc._quantize_np(x)
+    q, s = jnp.asarray(q), jnp.asarray(scales)
+    winner = np.asarray(cagg.krum_compressed(q, s, F))
+    u = np.asarray(cagg.dequantize(q, s))
+    matches = [i for i in range(T) if np.array_equal(winner, u[i])]
+    assert matches and matches[0] >= 2
+
+
+def test_krum_guard_matches_dense_guard():
+    q, s, _ = _quantized(t=6, seed=11)
+    with pytest.raises(ValueError, match="2f\\+3"):
+        cagg.krum_scores_compressed(q, s, 3)
+
+
+# ------------------------------------------------------------------ cclip
+
+
+@pytest.mark.parametrize("dup", [None, (2, 5)])
+def test_centered_clip_matches_dense(dup):
+    q, s, u = _quantized(seed=12, dup=dup)
+    got = np.asarray(cagg.centered_clip_compressed(q, s, tau=0.0, iters=8))
+    want = np.asarray(agg.centered_clip(u, tau=0.0, iters=8))
+    assert np.abs(got - want).max() < PATH_TOLERANCE_ATOL_COMPRESSED
+
+
+def test_centered_clip_huge_tau_is_the_mean():
+    q, s, u = _quantized(seed=13)
+    got = np.asarray(cagg.centered_clip_compressed(q, s, tau=1e9, iters=4))
+    np.testing.assert_allclose(
+        got, np.asarray(u).mean(axis=0), atol=PATH_TOLERANCE_ATOL_COMPRESSED, rtol=0
+    )
+
+
+def test_centered_clip_bounds_outlier_influence():
+    rng = np.random.default_rng(14)
+    x = rng.normal(size=(T, N)).astype(np.float32)
+    honest_mean = x[2:].mean(axis=0)
+    x[0] += 300.0
+    x[1] -= 250.0
+    q, scales = dc._quantize_np(x)
+    got = np.asarray(
+        cagg.centered_clip_compressed(jnp.asarray(q), jnp.asarray(scales))
+    )
+    # The compressed iterate must land near the honest mean, not the
+    # attack-dragged global mean. Quantization noise at absmax~300 and
+    # n=256 gives ~O(1) per-coordinate noise; compare in norm.
+    drag = np.linalg.norm(x.mean(axis=0) - honest_mean)
+    err = np.linalg.norm(got - honest_mean)
+    assert err < 0.25 * drag

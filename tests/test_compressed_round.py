@@ -1,0 +1,363 @@
+"""Compressed-delta wire format, round level: config gate, pack fn, driver.
+
+Layers under test:
+
+- ``Config`` validation: ``delta_compression`` composes only with the BRB
+  trust pipeline and plain/robust delta aggregators — every excluded
+  combination would insert a transform between the signed bytes and the
+  aggregated value.
+- ``parallel.build_compressed_pack_fn``: the ``[T, compressed_bytes]``
+  uint8 buffer must be BITWISE the ``ops.delta_codec`` reference encoding
+  of each gathered trainer row, one executable across trainer sets and
+  vacancy padding, digests framed by ``crypto.make_segment_digester``.
+- The driver end-to-end (``requires_spmd``): compressed rounds deliver and
+  verify through BRB with a quiet recompile sentinel, the flight stream
+  audits clean over compressed digests, and with compression OFF the
+  RoundRecord stream stays bit-identical to the pre-wire-format golden.
+- The lockstep chaos harness: ``payload_mode="compressed"`` runs are
+  deterministic, distinct from digest-mode runs, and deployment-independent
+  (in-memory mesh vs 3 real TCP processes) — all jax-free.
+"""
+
+import dataclasses
+import hashlib
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pdl_tpu.config import Config
+from p2pdl_tpu.ops import delta_codec as dc
+from p2pdl_tpu.ops import pallas_codec as pc
+from p2pdl_tpu.parallel import build_compressed_pack_fn, build_digest_pack_fn
+from p2pdl_tpu.protocol.audit import ProtocolAuditor, merge_streams
+from p2pdl_tpu.runtime.lockstep import ChaosSpec, run_in_memory
+from p2pdl_tpu.utils import flight
+
+requires_spmd = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="driver needs jax.shard_map (set P2PDL_JAX_COMPAT=1 for the shims)",
+)
+
+CFG = Config(
+    num_peers=8,
+    trainers_per_round=3,
+    rounds=2,
+    local_epochs=1,
+    samples_per_peer=32,
+    batch_size=32,
+    lr=0.05,
+    server_lr=1.0,
+    compute_dtype="float32",
+    byzantine_f=2,
+    brb_enabled=True,
+)
+
+
+# ------------------------------------------------------------ config gate
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(delta_compression="int8"),
+        dict(delta_compression="bf16"),
+        dict(delta_compression="topk", compress_ratio=0.01),
+        dict(delta_compression="topk", compress_ratio=1.0),
+        dict(delta_compression="none"),
+    ],
+)
+def test_config_accepts_supported_compression(kw):
+    cfg = dataclasses.replace(CFG, **kw)
+    assert cfg.delta_compression == kw["delta_compression"]
+
+
+@pytest.mark.parametrize(
+    "kw,match",
+    [
+        (dict(delta_compression="gzip"), "unknown delta_compression"),
+        (dict(delta_compression="int8", brb_enabled=False), "brb_enabled"),
+        (dict(delta_compression="int8", aggregator="gossip"), "plain or robust"),
+        (
+            dict(delta_compression="int8", aggregator="secure_fedavg"),
+            "plain or robust",
+        ),
+        (dict(delta_compression="int8", dp_clip=1.0), "DP is not supported"),
+        (dict(delta_compression="int8", scaffold=True), "scaffold/fednova"),
+        (dict(delta_compression="int8", fednova=True), "scaffold/fednova"),
+        (dict(delta_compression="topk", compress_ratio=0.0), "compress_ratio"),
+        (dict(delta_compression="topk", compress_ratio=1.5), "compress_ratio"),
+    ],
+)
+def test_config_rejects_unsound_compositions(kw, match):
+    with pytest.raises(ValueError, match=match):
+        dataclasses.replace(CFG, **kw)
+
+
+def test_config_rejects_scan_carry_compressor_combo():
+    # compress= (the simulation-only scan-carry transform) is refused with
+    # the trust plane active before the wire-format check even runs; the
+    # pair can never meet.
+    with pytest.raises(ValueError, match="compress with the BRB trust plane"):
+        dataclasses.replace(CFG, delta_compression="int8", compress="topk")
+
+
+# ------------------------------------------------------------ pack fn
+
+
+def _delta_tree(num_peers: int, seed: int = 0):
+    """Peer-stacked float update tree mixing dtypes, ranks, and a
+    scalar-per-peer leaf — the shapes the compressed pack must encode
+    exactly as the ``delta_codec`` host reference does."""
+    rng = np.random.default_rng(seed)
+    return {
+        "dense": {
+            "w": jnp.asarray(rng.normal(size=(num_peers, 6, 5)).astype(np.float32)),
+            "b": jnp.asarray(rng.normal(size=(num_peers, 5)).astype(np.float32)),
+        },
+        "head_bf16": jnp.asarray(
+            rng.normal(size=(num_peers, 9)).astype(np.float32)
+        ).astype(jnp.bfloat16),
+        "scale": jnp.asarray(rng.normal(size=(num_peers,)).astype(np.float32)),
+    }
+
+
+def _reference_row(delta, t: int, layout) -> bytes:
+    """Host-side oracle: per leaf in tree order, gather trainer ``t``'s
+    row, encode with the numpy reference codec, concatenate the segments."""
+    leaves = jax.tree_util.tree_flatten_with_path(delta)[0]
+    segs = []
+    for leaf_codec, (_, leaf) in zip(layout.leaves, leaves):
+        row = np.asarray(leaf)[t].astype(np.float32).reshape(1, -1)
+        segs.append(dc.encode_np(row, leaf_codec.mode, leaf_codec.k)[0])
+    return np.concatenate(segs).tobytes()
+
+
+@pytest.mark.parametrize("mode,ratio", [("int8", 0.0), ("bf16", 0.0), ("topk", 0.2)])
+def test_packed_rows_bitwise_match_reference_codec(mode, ratio):
+    delta = _delta_tree(8, seed=1)
+    pack_fn, hash_row = build_compressed_pack_fn(delta, mode, ratio)
+    layout = pack_fn.layout
+    trainers = np.array([1, 3, 6], np.int32)
+    buf = np.asarray(jax.device_get(pack_fn(delta, jnp.asarray(trainers))))
+    assert buf.dtype == np.uint8
+    assert buf.shape == (3, layout.total_bytes)
+    assert hash_row.total_bytes == layout.total_bytes
+    for i, t in enumerate(trainers):
+        want = _reference_row(delta, int(t), layout)
+        assert buf[i].tobytes() == want
+        # The BRB digest is the segment digester over those same bytes.
+        assert hash_row(buf[i]) == hash_row(np.frombuffer(want, np.uint8))
+
+
+def test_vacancy_clamp_packs_row_zero():
+    delta = _delta_tree(8, seed=2)
+    pack_fn, _ = build_compressed_pack_fn(delta, "int8", 0.0)
+    buf = np.asarray(
+        jax.device_get(pack_fn(delta, jnp.asarray(np.array([2, 5, -1], np.int32))))
+    )
+    clamped = np.asarray(
+        jax.device_get(pack_fn(delta, jnp.asarray(np.array([2, 5, 0], np.int32))))
+    )
+    assert buf.shape[0] == 3  # vacancy rows packed (clamped), not dropped
+    np.testing.assert_array_equal(buf, clamped)
+
+
+def test_pack_fn_single_compile_across_trainer_sets():
+    delta = _delta_tree(8, seed=3)
+    pack_fn, _ = build_compressed_pack_fn(delta, "topk", 0.3)
+    for idx in ([1, 3, 6], [0, -1, -1], [2, 5, -1], [7, 7, 7]):
+        pack_fn(delta, jnp.asarray(np.array(idx, np.int32)))
+    assert pack_fn.__wrapped__._cache_size() == 1
+
+
+def test_compressed_digests_differ_from_dense_digests():
+    """Domain separation end-to-end: the same delta and trainer produce
+    different signed digests under the dense and compressed packs — a
+    receiver can never confuse the two framings."""
+    delta = _delta_tree(8, seed=4)
+    dense_fn, dense_hash = build_digest_pack_fn(delta)
+    comp_fn, comp_hash = build_compressed_pack_fn(delta, "int8", 0.0)
+    idx = jnp.asarray(np.array([0], np.int32))
+    dense_row = np.asarray(jax.device_get(dense_fn(delta, idx)))[0]
+    comp_row = np.asarray(jax.device_get(comp_fn(delta, idx)))[0]
+    assert comp_row.nbytes < dense_row.nbytes  # it actually compressed
+    assert dense_hash(dense_row) != comp_hash(comp_row)
+
+
+def test_fused_kernel_path_is_bitwise_identical(monkeypatch):
+    """int8 pack routed through the fused Pallas kernel (interpret mode off
+    TPU) emits the same bytes as the XLA encoder path."""
+    if not pc.available():
+        pytest.skip("pallas unavailable on this build (compat shims active)")
+    delta = _delta_tree(8, seed=5)
+    idx = jnp.asarray(np.array([1, 4, 7], np.int32))
+    xla_fn, _ = build_compressed_pack_fn(delta, "int8", 0.0)
+    want = np.asarray(jax.device_get(xla_fn(delta, idx)))
+    monkeypatch.setattr(pc, "_FORCE_INTERPRET", True)
+    fused_fn, _ = build_compressed_pack_fn(delta, "int8", 0.0)
+    got = np.asarray(jax.device_get(fused_fn(delta, idx)))
+    np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------------------------------------ driver E2E
+
+
+def _stripped_stream(records) -> str:
+    out = []
+    for rec in records:
+        d = rec.to_dict()
+        d.pop("duration_s", None)
+        ph = d.get("protocol_health")
+        if isinstance(ph, dict):
+            ph = dict(ph)
+            ph.pop("brb_latency_s", None)
+            d["protocol_health"] = ph
+        out.append(d)
+    return json.dumps(out, sort_keys=True, separators=(",", ":"))
+
+
+# Captured from the pre-wire-format driver (delta_compression did not yet
+# exist): Config below with rounds [1, 3, 6] then [0, 2, 5], duration_s and
+# protocol_health["brb_latency_s"] stripped. Compression OFF must keep the
+# stream bit-identical to this.
+GOLDEN_CFG = dataclasses.replace(CFG, local_epochs=2)
+GOLDEN_SHA256 = "bd7fb4f2e36fb278460bb63f7af3917626dcde6e2e3ab5e4e977ae10592dd27a"
+
+
+@requires_spmd
+def test_roundrecord_stream_unchanged_with_compression_off():
+    from p2pdl_tpu.runtime.driver import Experiment
+
+    exp = Experiment(GOLDEN_CFG)
+    exp.run_round(trainers=np.asarray([1, 3, 6]))
+    exp.run_round(trainers=np.asarray([0, 2, 5]))
+    stream = _stripped_stream(exp.records)
+    assert hashlib.sha256(stream.encode()).hexdigest() == GOLDEN_SHA256
+
+
+@requires_spmd
+@pytest.mark.parametrize("mode,ratio", [("int8", 0.1), ("topk", 0.05)])
+def test_compressed_rounds_deliver_and_verify(mode, ratio):
+    from p2pdl_tpu.runtime.driver import Experiment
+
+    cfg = dataclasses.replace(
+        CFG, delta_compression=mode, compress_ratio=ratio
+    )
+    exp = Experiment(cfg)
+    exp.run_round(trainers=np.asarray([1, 3, 6]))
+    exp.run_round(trainers=np.asarray([0, 2, 5]))
+    for rec in exp.records:
+        assert np.isfinite(rec.train_loss)
+        assert rec.brb_delivered == cfg.num_peers
+        assert not rec.brb_excluded_trainers
+    # The signed wire really was the compressed layout, not the dense one.
+    pack_fn, hash_row = exp._digest_pack
+    assert pack_fn.layout.mode == mode
+    assert hash_row.total_bytes == pack_fn.layout.total_bytes
+    dense_bytes = sum(
+        leaf.n * jnp.asarray([], leaf.dtype).dtype.itemsize
+        for leaf in pack_fn.layout.leaves
+    )
+    assert pack_fn.layout.total_bytes < dense_bytes
+
+
+@requires_spmd
+def test_sentinel_quiet_across_vacancies_with_compression():
+    from p2pdl_tpu.runtime.driver import Experiment
+
+    cfg = dataclasses.replace(CFG, delta_compression="int8", rounds=3)
+    exp = Experiment(cfg)
+    exp.run_round(trainers=np.asarray([1, 3, 6]))
+    exp.run_round(trainers=np.asarray([0, 2, -1]))  # shrunken round
+    exp.run_round(trainers=np.asarray([4, 5, 7]))
+    assert exp.sentinel.recompiles == 0
+    assert exp._digest_pack[0].__wrapped__._cache_size() == 1
+
+
+@requires_spmd
+def test_audit_clean_over_compressed_digests():
+    """`cli audit`'s invariants hold unchanged when the flight stream's
+    digests are over compressed bytes — agg_admit lineage keyed by the
+    compressed digest still closes against brb_deliver."""
+    from p2pdl_tpu.runtime.driver import Experiment
+
+    prior = flight.enabled()
+    try:
+        flight.set_enabled(True)
+        flight.reset()
+        cfg = dataclasses.replace(CFG, delta_compression="int8")
+        exp = Experiment(cfg)
+        exp.run_round(trainers=np.asarray([1, 3, 6]))
+        exp.run_round(trainers=np.asarray([0, 2, 5]))
+        events = flight.recorder().events(strip_time=True)
+    finally:
+        flight.reset()
+        flight.set_enabled(prior)
+    admits = [ev for ev in events if ev["kind"] == "agg_admit"]
+    assert {ev["trainer"] for ev in admits} == {0, 1, 2, 3, 5, 6}
+    auditor = ProtocolAuditor(registered=range(cfg.num_peers))
+    assert auditor.audit(merge_streams([events])) == []
+
+
+# ------------------------------------------------------------ lockstep
+
+
+COMPRESSED_SPEC = ChaosSpec(
+    num_peers=6, num_hosts=3, rounds=2, f=1,
+    plan="crash_drop_partition", seed=7, payload_mode="compressed",
+)
+
+
+def test_chaosspec_rejects_unknown_payload_mode():
+    with pytest.raises(ValueError, match="payload_mode"):
+        ChaosSpec(num_peers=6, num_hosts=3, payload_mode="gzip")
+
+
+def test_chaosspec_payload_mode_crosses_process_boundary():
+    spec = ChaosSpec.from_dict(
+        json.loads(json.dumps(COMPRESSED_SPEC.to_dict()))
+    )
+    assert spec.payload_mode == "compressed"
+    assert spec == dataclasses.replace(
+        COMPRESSED_SPEC, plan=COMPRESSED_SPEC.resolved_plan()
+    )
+
+
+def test_compressed_inmemory_rerun_is_bit_identical():
+    base = run_in_memory(COMPRESSED_SPEC)
+    again = run_in_memory(COMPRESSED_SPEC)
+    assert again["digests"] == base["digests"]
+    assert again["streams"] == base["streams"]
+    assert again["records"] == base["records"]
+
+
+def test_compressed_payloads_change_the_flight_digests():
+    """The compressed payload actually flows through the runs: same seed
+    and plan, different payload_mode, different determinism digests (the
+    broadcast digests are over different bytes)."""
+    digest_mode = run_in_memory(
+        dataclasses.replace(COMPRESSED_SPEC, payload_mode="digest")
+    )
+    compressed = run_in_memory(COMPRESSED_SPEC)
+    assert compressed["digests"] != digest_mode["digests"]
+
+
+def test_compressed_tcp_run_matches_inmemory_bit_for_bit():
+    """Deployment independence for the compressed wire: 3 real processes
+    over loopback TCP produce the same per-host flight digests and round
+    records as the in-memory mesh under payload_mode='compressed'."""
+    from test_chaos_tcp import _launch_cluster, _stop_cluster
+
+    base = run_in_memory(COMPRESSED_SPEC)
+    procs, verdicts, _ = _launch_cluster(COMPRESSED_SPEC)
+    try:
+        assert [v["digest"] for v in verdicts] == base["digests"]
+        assert [v["records"] for v in verdicts] == base["records"]
+        for v in verdicts:
+            assert v["lost_sends"] == 0
+            assert v["transport"]["sent"] > 0
+    finally:
+        _stop_cluster(procs)

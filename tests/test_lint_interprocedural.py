@@ -294,6 +294,50 @@ def test_wiretaint_flags_allocation_sized_by_wire_int():
     assert "amplification" in findings[0].message
 
 
+def test_wiretaint_flags_decompression_buffer_sized_by_wire_int():
+    # The compressed-delta shape: a decoder that trusts a wire-carried
+    # element count allocates attacker-chosen memory before verification.
+    findings = lint(
+        """
+        import numpy as np
+        from p2pdl_tpu.protocol.transport import recv_frame
+
+        def decode(sock):
+            frame = recv_frame(sock)
+            n = frame[0]
+            out = np.zeros(n)
+            vals = np.frombuffer(frame, dtype=np.int8, count=n)
+            return out, vals
+        """,
+        "ops/fake_codec.py",
+    )
+    assert rules_of(findings) == {"wire-taint"}
+    assert len(findings) == 2
+    assert all("amplification" in f.message for f in findings)
+
+
+def test_wiretaint_decompression_bound_check_sanitizes_the_count():
+    assert (
+        lint(
+            """
+            import numpy as np
+            from p2pdl_tpu.protocol.transport import recv_frame
+
+            MAX_LEAF = 1 << 20
+
+            def decode(sock):
+                frame = recv_frame(sock)
+                n = frame[0]
+                if n > MAX_LEAF:
+                    return None
+                return np.zeros(n)
+            """,
+            "ops/fake_codec.py",
+        )
+        == []
+    )
+
+
 def test_wiretaint_flags_unpack_with_tainted_slice_bounds():
     findings = lint(
         """

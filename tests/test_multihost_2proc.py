@@ -251,7 +251,12 @@ def test_control_plane_defaults_to_async_transport():
     legacy = _unit_plane(0, 1, [("127.0.0.1", ports[0])], transport="tcp")
     try:
         assert isinstance(legacy.transport, TCPTransport)
-        assert legacy.transport_stats() == {"transport": "tcp"}
+        stats = legacy.transport_stats()
+        assert stats["transport"] == "tcp"
+        # The legacy plane carries the same wire-accounting surface as the
+        # async one (idle here: nothing shipped yet).
+        assert stats["tx_bytes"] == 0 and stats["rx_bytes"] == 0
+        assert stats["tx_bytes_by_peer"] == {} == stats["rx_bytes_by_peer"]
     finally:
         legacy.stop()
 
