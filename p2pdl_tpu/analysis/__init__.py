@@ -3,9 +3,12 @@
 Public surface: the engine (:func:`run_lint`, :func:`lint_source`,
 :func:`cli_lint`) plus the rule families registered on import —
 determinism, host-sync, lock discipline, wire conformance, and the
-interprocedural families (wire-taint, lock-membership, lock-order) built
-on the call-graph/dataflow layer (``callgraph.py`` / ``dataflow.py``).
-See ``engine.py`` for the suppression and baseline model.
+interprocedural families (wire-taint, lock-membership, lock-order, and
+the async family: async-blocking-call / async-lock-stall /
+async-coroutine-drop / async-loop-state) built on the
+call-graph/dataflow layer (``callgraph.py`` / ``dataflow.py`` /
+``asyncflow.py``). See ``engine.py`` for the suppression and baseline
+model.
 """
 
 from p2pdl_tpu.analysis.engine import (  # noqa: F401

@@ -1,0 +1,609 @@
+"""Async concurrency rules: event-loop blocking, hybrid locks, lifecycle.
+
+The asyncio TCP plane (``protocol/aio_transport.py``) runs one event loop
+on a dedicated thread while the trainer threads talk to it through
+thread-safe entry points. That split creates three whole-program
+invariants no per-file rule can see:
+
+1. **Nothing reachable from the loop may block.** :class:`AsyncModel`
+   colors every call-graph function with a "runs on the event loop"
+   context — seeded from ``async def`` bodies and from sync callbacks
+   handed to ``call_soon`` / ``call_soon_threadsafe`` / ``call_later`` /
+   ``call_at`` / ``add_done_callback`` — and propagates it through
+   resolved call edges, keeping the witness chain for the report.
+   ``async-blocking-call`` then flags blocking sinks (``time.sleep``,
+   sync ``socket.*`` / ``subprocess.*``, file I/O, ``Future.result()``,
+   blocking ``queue.Queue`` methods, ``Condition.wait``) in any colored
+   function. A ``threading.Lock`` acquisition on the loop is flagged only
+   when the lock is *slow* — held across an ``await`` or a blocking sink
+   somewhere in the program — so the transport's short stats-guarding
+   critical sections stay clean while a genuinely stall-prone lock is
+   caught at every loop-side acquisition.
+
+2. **The loop must never suspend while holding a thread lock.**
+   ``async-lock-stall`` flags ``await`` / ``async with`` / ``async for``
+   with a ``threading.Lock`` identity held: the coroutine parks with the
+   lock taken and every thread (and every coroutine that needs the lock)
+   stalls behind a suspension of unbounded length. The lock identities
+   are the hybrid :mod:`lockflow` model's — ``asyncio.Lock`` /
+   ``Condition`` get program-unique identities through the same factories
+   table, so lock-order cycle detection spans the thread↔loop boundary.
+
+3. **Coroutine objects and loop-owned state have an ownership
+   discipline.** ``async-coroutine-drop`` flags a resolved call to an
+   ``async def`` used as an expression statement (the coroutine is built
+   and discarded, its body never runs) and a ``create_task`` /
+   ``ensure_future`` / ``run_coroutine_threadsafe`` result that is
+   dropped (task exceptions vanish with the last reference).
+   ``async-loop-state`` flags an attribute written both by loop-colored
+   and by thread-side methods of one class with no common ``threading``
+   lock guarding every site (lexically or via call-graph attribution) —
+   the fix is routing the thread-side mutation through
+   ``call_soon_threadsafe`` / ``run_coroutine_threadsafe`` or guarding
+   both sides.
+
+Soundness limits mirror the call graph's: dynamic dispatch produces no
+edge, so a handler invoked through a stored callable is not colored and
+its body is not checked; coloring one level of ``functools.partial`` or
+closures handed to the loop is out of scope. The model is conservative
+the other way too: a helper called from both worlds is colored and must
+be loop-safe.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from typing import Iterable, Optional
+
+from p2pdl_tpu.analysis.callgraph import FunctionNode
+from p2pdl_tpu.analysis.engine import (
+    Finding,
+    Program,
+    ProgramRule,
+    register,
+)
+from p2pdl_tpu.analysis.lockflow import LockModel, lock_model_for, own_nodes
+from p2pdl_tpu.analysis.locks import _self_attr
+
+#: Loop APIs whose result must be retained (silent-exception sink).
+_SPAWNERS = frozenset({"create_task", "ensure_future", "run_coroutine_threadsafe"})
+#: Loop APIs taking a sync callback: method name -> callback arg index.
+_CALLBACK_ARG = {
+    "call_soon": 0,
+    "call_soon_threadsafe": 0,
+    "add_done_callback": 0,
+    "call_later": 1,
+    "call_at": 1,
+}
+#: Canonical dotted names that block the calling thread outright.
+_BLOCKING_DOTTED = frozenset(
+    {
+        "time.sleep",
+        "open",
+        "io.open",
+        "os.read",
+        "os.write",
+        "os.popen",
+        "select.select",
+    }
+)
+#: Any module-level call into these modules is synchronous I/O.
+_BLOCKING_MODULES = frozenset({"subprocess", "socket"})
+#: Stdlib thread-queue factories (Queue.get/put block by default).
+_QUEUE_FACTORIES = frozenset(
+    {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue", "queue.SimpleQueue"}
+)
+_QUEUE_BLOCKING_METHODS = frozenset({"get", "put", "join"})
+
+_AMBIGUOUS = ("<ambiguous>",)
+
+
+def _is_thread_lock(model: LockModel, lid: tuple) -> bool:
+    factory = model.lock_factory(lid)
+    return factory is not None and factory.startswith("threading.")
+
+
+def _call_nonblocking(call: ast.Call) -> bool:
+    """``q.get(False)`` / ``q.get(block=False)`` do not block."""
+    if call.args and isinstance(call.args[0], ast.Constant):
+        if call.args[0].value is False:
+            return True
+    for kw in call.keywords:
+        if (
+            kw.arg == "block"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is False
+        ):
+            return True
+    return False
+
+
+class AsyncModel:
+    """Loop-context coloring + slow-lock facts, shared by the async rules."""
+
+    def __init__(self, program: Program) -> None:
+        self.program = program
+        self.graph = program.callgraph
+        self.locks = lock_model_for(program)
+        #: fn key -> witness chain of fn keys from a loop root to it.
+        self.loop_ctx: dict[str, tuple[str, ...]] = {}
+        #: loop-root fn key -> how it enters the loop (for the report).
+        self.root_kind: dict[str, str] = {}
+        #: thread-lock id -> why it can stall its holder ("slow" locks).
+        self.slow_locks: dict[tuple, str] = {}
+        #: (relpath, cls_qual) -> queue attr names; mirrors the lock model.
+        self._queue_class_attrs: dict[tuple[str, str], set[str]] = {}
+        self._queue_attr_owner: dict[str, tuple] = {}
+        self._queue_globals: dict[str, set[str]] = {}
+        self._collect_queues()
+        self._color()
+        self._find_slow_locks()
+
+    # -- queue ownership (same shape as LockModel's lock ownership) --------
+
+    def _collect_queues(self) -> None:
+        for mod in self.program.mods:
+            for node in mod.walk():
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                attrs: set[str] = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) and isinstance(
+                        sub.value, ast.Call
+                    ):
+                        if mod.dotted(sub.value.func) in _QUEUE_FACTORIES:
+                            for t in sub.targets:
+                                attr = _self_attr(t)
+                                if attr is not None:
+                                    attrs.add(attr)
+                if attrs:
+                    key = (mod.relpath, mod.context_of(node))
+                    self._queue_class_attrs[key] = attrs
+                    for attr in attrs:
+                        if attr in self._queue_attr_owner:
+                            self._queue_attr_owner[attr] = _AMBIGUOUS
+                        else:
+                            self._queue_attr_owner[attr] = key
+            globs = {
+                t.id
+                for st in mod.tree.body
+                if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call)
+                if mod.dotted(st.value.func) in _QUEUE_FACTORIES
+                for t in st.targets
+                if isinstance(t, ast.Name)
+            }
+            if globs:
+                self._queue_globals[mod.relpath] = globs
+
+    def queue_display(self, fn: FunctionNode, expr: ast.AST) -> Optional[str]:
+        """Display name of a known thread-queue receiver, else None."""
+        attr = _self_attr(expr)
+        if attr is not None:
+            if fn.cls is not None and attr in self._queue_class_attrs.get(
+                (fn.relpath, fn.cls), set()
+            ):
+                return f"self.{attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self._queue_globals.get(fn.relpath, set()):
+                return expr.id
+            return None
+        if isinstance(expr, ast.Attribute):
+            owner = self._queue_attr_owner.get(expr.attr)
+            if owner is not None and owner != _AMBIGUOUS:
+                return f".{expr.attr}"
+        return None
+
+    # -- loop-context coloring ---------------------------------------------
+
+    def _resolve_ref(self, fn: FunctionNode, expr: ast.AST) -> Optional[FunctionNode]:
+        """A bare function reference (``self._wake`` / ``helper``) handed
+        to a loop API, resolved with the call graph's conservatism."""
+        attr = _self_attr(expr)
+        if attr is not None and fn.cls is not None:
+            return self.graph.functions.get(f"{fn.relpath}::{fn.cls}.{attr}")
+        if isinstance(expr, ast.Name):
+            for qual in (f"{fn.qualname}.{expr.id}", expr.id):
+                target = self.graph.functions.get(f"{fn.relpath}::{qual}")
+                if target is not None:
+                    return target
+        return None
+
+    def _color(self) -> None:
+        roots: list[tuple[str, str]] = [
+            (key, "an `async def`")
+            for key, fn in self.graph.functions.items()
+            if fn.is_async
+        ]
+        for key, fn in self.graph.functions.items():
+            for node in own_nodes(fn):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                ):
+                    continue
+                idx = _CALLBACK_ARG.get(node.func.attr)
+                if idx is None or len(node.args) <= idx:
+                    continue
+                target = self._resolve_ref(fn, node.args[idx])
+                if target is not None and not target.is_async:
+                    roots.append(
+                        (
+                            target.key,
+                            "a loop callback registered in "
+                            f"`{fn.qualname}` via `{node.func.attr}`",
+                        )
+                    )
+        work: deque[str] = deque()
+        for key, kind in roots:
+            if key in self.loop_ctx:
+                continue
+            self.loop_ctx[key] = (key,)
+            self.root_kind[key] = kind
+            work.append(key)
+        while work:
+            k = work.popleft()
+            for site in self.graph.callees_of(k):
+                if site.callee in self.loop_ctx:
+                    continue
+                self.loop_ctx[site.callee] = self.loop_ctx[k] + (site.callee,)
+                work.append(site.callee)
+
+    def chain_display(self, key: str) -> str:
+        chain = self.loop_ctx[key]
+        quals = [self.graph.functions[k].qualname for k in chain]
+        head = f"`{quals[0]}`, {self.root_kind.get(chain[0], 'an `async def`')}"
+        if len(quals) == 1:
+            return head
+        return head + ", via " + " -> ".join(f"`{q}`" for q in quals[1:])
+
+    # -- blocking-sink classification --------------------------------------
+
+    def blocking_call(self, fn: FunctionNode, call: ast.Call) -> Optional[str]:
+        """Description of a call that blocks its thread, else None.
+
+        Thread-lock ``.acquire()`` is *not* classified here — the blocking
+        rule applies the slow-lock refinement to acquisitions itself.
+        """
+        dotted = fn.mod.dotted(call.func)
+        if dotted is not None:
+            if dotted in _BLOCKING_DOTTED:
+                return f"{dotted}()"
+            parts = dotted.split(".")
+            if len(parts) >= 2 and parts[0] in _BLOCKING_MODULES:
+                return f"{dotted}()"
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr == "result":
+                return ".result()"
+            if attr in _QUEUE_BLOCKING_METHODS and not _call_nonblocking(call):
+                q = self.queue_display(fn, call.func.value)
+                if q is not None:
+                    return f"{q}.{attr}()"
+            if attr in ("wait", "wait_for"):
+                lid = self.locks.lock_id(fn, call.func.value)
+                if lid is not None and _is_thread_lock(self.locks, lid):
+                    return f"{self.locks.display(lid)}.{attr}()"
+        return None
+
+    # -- slow threading locks ----------------------------------------------
+
+    def _mark_slow(self, held: Iterable[tuple], reason: str) -> None:
+        for lid in sorted(held):
+            if _is_thread_lock(self.locks, lid) and lid not in self.slow_locks:
+                self.slow_locks[lid] = reason
+
+    def _find_slow_locks(self) -> None:
+        lm = self.locks
+        #: fn key -> why its own body can block/suspend (first reason wins).
+        own_block: dict[str, str] = {}
+        for key, fn in self.graph.functions.items():
+            for node in own_nodes(fn):
+                if isinstance(node, ast.Await):
+                    reason = f"an `await` in `{fn.qualname}`"
+                    self._mark_slow(lm.held_at(key, node), reason)
+                    own_block.setdefault(key, reason)
+                elif isinstance(node, ast.AsyncWith):
+                    anchor = node.items[0].context_expr
+                    reason = f"an `async with` suspension in `{fn.qualname}`"
+                    self._mark_slow(lm.held_at(key, anchor), reason)
+                    own_block.setdefault(key, reason)
+                elif isinstance(node, ast.AsyncFor):
+                    reason = f"an `async for` suspension in `{fn.qualname}`"
+                    self._mark_slow(lm.held_at(key, node.iter), reason)
+                    own_block.setdefault(key, reason)
+                elif isinstance(node, ast.Call):
+                    desc = self.blocking_call(fn, node)
+                    if desc is None:
+                        continue
+                    held = set(lm.held_at(key, node))
+                    # Condition.wait releases its own lock while parked.
+                    if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                        "wait",
+                        "wait_for",
+                    ):
+                        held.discard(lm.lock_id(fn, node.func.value))
+                    reason = f"`{desc}` in `{fn.qualname}`"
+                    self._mark_slow(held, reason)
+                    own_block.setdefault(key, reason)
+        # A lock held across a *call* whose callee (transitively) blocks is
+        # just as slow as one held across the sink itself.
+        may_block = dict(own_block)
+        changed = True
+        while changed:
+            changed = False
+            for key in self.graph.functions:
+                if key in may_block:
+                    continue
+                for site in self.graph.callees_of(key):
+                    reason = may_block.get(site.callee)
+                    if reason is not None:
+                        may_block[key] = reason
+                        changed = True
+                        break
+        for key, fn in self.graph.functions.items():
+            for site in self.graph.callees_of(key):
+                reason = may_block.get(site.callee)
+                if reason is None:
+                    continue
+                held = lm.held_at(key, site.call)
+                if held:
+                    callee = self.graph.functions[site.callee]
+                    self._mark_slow(
+                        held,
+                        f"a call to `{callee.qualname}` (which reaches "
+                        f"{reason})",
+                    )
+
+
+def async_model_for(program: Program) -> AsyncModel:
+    model = getattr(program, "_async_model", None)
+    if model is None:
+        model = AsyncModel(program)
+        program._async_model = model
+    return model
+
+
+# ---- async-blocking-call ------------------------------------------------------
+
+
+class EventLoopBlockingRule(ProgramRule):
+    name = "async-blocking-call"
+    description = (
+        "blocking sink reachable from event-loop context "
+        "(stalls every coroutine on the loop)"
+    )
+    scope = None  # everywhere
+
+    def check_program(self, program: Program) -> Iterable[Finding]:
+        model = async_model_for(program)
+        lm = model.locks
+        for key in model.loop_ctx:
+            fn = model.graph.functions[key]
+            if not self.applies(fn.mod):
+                continue
+            chain = model.chain_display(key)
+            for node in own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                desc = model.blocking_call(fn, node)
+                if desc is not None:
+                    yield fn.mod.finding(
+                        self.name,
+                        node,
+                        f"blocking call `{desc}` runs on the event loop "
+                        f"(reached from {chain}) — every coroutine on the "
+                        "loop stalls behind it; use the async equivalent or "
+                        "offload via `run_in_executor`",
+                    )
+                    continue
+                # Explicit lock.acquire(): slow-lock refinement.
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"
+                ):
+                    lid = lm.lock_id(fn, node.func.value)
+                    if (
+                        lid is not None
+                        and _is_thread_lock(lm, lid)
+                        and lid in model.slow_locks
+                    ):
+                        yield self._slow_lock_finding(
+                            fn, node, lm, model, lid, chain
+                        )
+            for lid, expr, _held_before in lm.acquires.get(key, ()):
+                if _is_thread_lock(lm, lid) and lid in model.slow_locks:
+                    yield self._slow_lock_finding(fn, expr, lm, model, lid, chain)
+
+    def _slow_lock_finding(self, fn, node, lm, model, lid, chain) -> Finding:
+        return fn.mod.finding(
+            self.name,
+            node,
+            f"threading lock `{lm.display(lid)}` is taken on the event loop "
+            f"(reached from {chain}) but is held across "
+            f"{model.slow_locks[lid]} — a stalled holder freezes the loop",
+        )
+
+
+# ---- async-lock-stall ---------------------------------------------------------
+
+
+class AwaitUnderThreadLockRule(ProgramRule):
+    name = "async-lock-stall"
+    description = (
+        "coroutine suspends (`await` / `async with` / `async for`) while a "
+        "threading lock is held"
+    )
+    scope = None  # everywhere
+
+    def check_program(self, program: Program) -> Iterable[Finding]:
+        lm = lock_model_for(program)
+        for key, fn in lm.graph.functions.items():
+            if not self.applies(fn.mod):
+                continue
+            reported: set[tuple] = set()
+            for node in own_nodes(fn):
+                if isinstance(node, ast.Await):
+                    anchor, label = node, "`await`"
+                elif isinstance(node, ast.AsyncWith):
+                    anchor, label = node.items[0].context_expr, "`async with`"
+                elif isinstance(node, ast.AsyncFor):
+                    anchor, label = node.iter, "`async for`"
+                else:
+                    continue
+                for lid in sorted(lm.held_at(key, anchor)):
+                    if not _is_thread_lock(lm, lid) or lid in reported:
+                        continue
+                    reported.add(lid)
+                    yield fn.mod.finding(
+                        self.name,
+                        node,
+                        f"{label} in `{fn.qualname}` while threading lock "
+                        f"`{lm.display(lid)}` is held — the coroutine parks "
+                        "with the lock taken, stalling every thread and "
+                        "coroutine that needs it; release before suspending "
+                        "or switch to `asyncio.Lock`",
+                    )
+
+
+# ---- async-coroutine-drop -----------------------------------------------------
+
+
+class CoroutineLifecycleRule(ProgramRule):
+    name = "async-coroutine-drop"
+    description = (
+        "coroutine built but never awaited, or task handle dropped "
+        "(silent-exception sink)"
+    )
+    scope = None  # everywhere
+
+    def check_program(self, program: Program) -> Iterable[Finding]:
+        graph = program.callgraph
+        for key, fn in graph.functions.items():
+            if not self.applies(fn.mod):
+                continue
+            for node in own_nodes(fn):
+                if not (
+                    isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)
+                ):
+                    continue
+                call = node.value
+                callee_key = graph.resolved_calls.get(id(call))
+                if callee_key is not None and graph.functions[callee_key].is_async:
+                    callee = graph.functions[callee_key]
+                    yield fn.mod.finding(
+                        self.name,
+                        node,
+                        f"coroutine `{callee.short_name}()` is called but "
+                        "never awaited — the coroutine object is discarded "
+                        "and its body never runs",
+                    )
+                    continue
+                spawner = self._spawner_name(fn, call)
+                if spawner is not None:
+                    yield fn.mod.finding(
+                        self.name,
+                        node,
+                        f"`{spawner}(...)` result is dropped — keep the "
+                        "task/future reference (or add a done-callback); "
+                        "otherwise it can be garbage-collected mid-flight "
+                        "and its exceptions vanish",
+                    )
+
+    @staticmethod
+    def _spawner_name(fn: FunctionNode, call: ast.Call) -> Optional[str]:
+        if isinstance(call.func, ast.Attribute) and call.func.attr in _SPAWNERS:
+            return call.func.attr
+        dotted = fn.mod.dotted(call.func)
+        if dotted is not None and dotted.split(".")[-1] in _SPAWNERS:
+            return dotted.split(".")[-1]
+        return None
+
+
+# ---- async-loop-state ---------------------------------------------------------
+
+
+class LoopStateRule(ProgramRule):
+    name = "async-loop-state"
+    description = (
+        "attribute written both on the event loop and from plain threads "
+        "with no common lock"
+    )
+    scope = None  # everywhere
+
+    def check_program(self, program: Program) -> Iterable[Finding]:
+        from p2pdl_tpu.analysis.lockflow import _write_targets
+
+        model = async_model_for(program)
+        lm = model.locks
+        classes: dict[tuple[str, str], list[FunctionNode]] = {}
+        for fn in model.graph.functions.values():
+            if fn.cls is not None:
+                classes.setdefault((fn.relpath, fn.cls), []).append(fn)
+        # Nested defs (closures in methods) write through captured `self`.
+        for fn in model.graph.functions.values():
+            if fn.cls is not None:
+                continue
+            for (relpath, cls_qual), fns in classes.items():
+                if fn.relpath == relpath and fn.qualname.startswith(cls_qual + "."):
+                    fns.append(fn)
+                    break
+        for (relpath, cls_qual) in sorted(classes):
+            mod = program.module(relpath)
+            if mod is None or not self.applies(mod):
+                continue
+            lock_attrs = set(lm.class_locks.get((relpath, cls_qual), {}))
+            writes: dict[str, dict[str, list[tuple[FunctionNode, ast.AST]]]] = {}
+            for fn in classes[(relpath, cls_qual)]:
+                if fn.qualname == f"{cls_qual}.__init__":
+                    continue  # not yet shared across threads
+                side = "loop" if fn.key in model.loop_ctx else "thread"
+                for node in own_nodes(fn):
+                    for target in _write_targets(node):
+                        attr = _self_attr(target)
+                        if attr is None or attr in lock_attrs:
+                            continue
+                        writes.setdefault(
+                            attr, {"loop": [], "thread": []}
+                        )[side].append((fn, node))
+            thread_lids = [
+                lid
+                for lid in lm.class_lock_ids(relpath, cls_qual)
+                if _is_thread_lock(lm, lid)
+            ]
+            for attr in sorted(writes):
+                sides = writes[attr]
+                if not sides["loop"] or not sides["thread"]:
+                    continue
+                all_sites = sides["loop"] + sides["thread"]
+                if any(
+                    self._guards_all(lm, lid, all_sites) for lid in thread_lids
+                ):
+                    continue
+                loop_qual = sorted(f.qualname for f, _ in sides["loop"])[0]
+                site_fn, site = min(
+                    sides["thread"], key=lambda p: getattr(p[1], "lineno", 0)
+                )
+                yield mod.finding(
+                    self.name,
+                    site,
+                    f"`self.{attr}` of `{cls_qual}` is written on the event "
+                    f"loop (`{loop_qual}`) and from plain threads "
+                    f"(`{site_fn.qualname}`) with no common lock — route the "
+                    "thread-side mutation through `call_soon_threadsafe` / "
+                    "`run_coroutine_threadsafe`, or guard every write site",
+                )
+
+    @staticmethod
+    def _guards_all(lm: LockModel, lid: tuple, sites) -> bool:
+        return all(
+            lid in lm.held_at(fn.key, node) or lm.entered_locked(fn.key, [lid])
+            for fn, node in sites
+        )
+
+
+register(EventLoopBlockingRule())
+register(AwaitUnderThreadLockRule())
+register(CoroutineLifecycleRule())
+register(LoopStateRule())

@@ -50,6 +50,10 @@ class FunctionNode:
     def short_name(self) -> str:
         return self.node.name
 
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.node, ast.AsyncFunctionDef)
+
     def param_names(self, skip_self: bool = True) -> list[str]:
         a = self.node.args
         names = [p.arg for p in a.posonlyargs + a.args]

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import fnmatch
 import json
 import os
 import subprocess
@@ -293,18 +294,26 @@ def register(rule: Rule) -> Rule:
 
 
 def all_rules() -> list[Rule]:
-    """Every registered rule, rule modules imported on first use."""
-    if not _RULES:
-        from p2pdl_tpu.analysis import (  # noqa: F401
-            cardinality,
-            determinism,
-            donation,
-            hostsync,
-            lockflow,
-            locks,
-            wire,
-            wiretaint,
-        )
+    """Every registered rule, rule modules imported on first use.
+
+    The import is unconditional (not guarded on ``_RULES`` being empty):
+    rule modules import each other — ``asyncflow`` pulls in ``lockflow``
+    and ``locks`` — so a direct import of one of them pre-populates the
+    registry and an emptiness guard would then skip the remaining
+    families forever. Re-imports are cached no-ops, so this stays cheap
+    and each module still registers exactly once.
+    """
+    from p2pdl_tpu.analysis import (  # noqa: F401
+        asyncflow,
+        cardinality,
+        determinism,
+        donation,
+        hostsync,
+        lockflow,
+        locks,
+        wire,
+        wiretaint,
+    )
 
     return list(_RULES.values())
 
@@ -681,18 +690,32 @@ def changed_files(root: str) -> list[str]:
 
 
 def resolve_rules(only: Optional[str]) -> Optional[list[Rule]]:
-    """``--only a,b`` -> rule instances; unknown names raise ValueError."""
+    """``--only a,b`` -> rule instances. Entries may be ``fnmatch`` globs
+    (``async-*`` selects the whole family); a name or pattern matching no
+    registered rule raises ValueError."""
     if not only:
         return None
     names = [n.strip() for n in only.split(",") if n.strip()]
     by_name = {r.name: r for r in all_rules()}
-    unknown = [n for n in names if n not in by_name]
+    selected: list[str] = []
+    unknown: list[str] = []
+    for n in names:
+        if any(ch in n for ch in "*?["):
+            hits = sorted(k for k in by_name if fnmatch.fnmatchcase(k, n))
+            if not hits:
+                unknown.append(n)
+            selected.extend(h for h in hits if h not in selected)
+        elif n in by_name:
+            if n not in selected:
+                selected.append(n)
+        else:
+            unknown.append(n)
     if unknown:
         raise ValueError(
             f"unknown rule(s): {', '.join(unknown)} "
             f"(known: {', '.join(sorted(by_name))})"
         )
-    return [by_name[n] for n in names]
+    return [by_name[n] for n in selected]
 
 
 def cli_lint(

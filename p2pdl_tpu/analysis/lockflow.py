@@ -58,6 +58,11 @@ _MEMBERSHIP_RE = re.compile(
 
 _AMBIGUOUS = ("<ambiguous>",)
 
+#: Factories whose locks deadlock on re-acquisition by the same holder.
+#: ``threading.RLock`` and ``threading.Condition`` (which wraps an RLock)
+#: are reentrant; ``asyncio.Lock``/``Condition`` are not.
+_NON_REENTRANT = frozenset({"threading.Lock", "asyncio.Lock", "asyncio.Condition"})
+
 
 def _class_qual(mod: ModuleInfo, cls: ast.ClassDef) -> str:
     # ``context_of`` on a class node is its own qualname already.
@@ -191,6 +196,11 @@ class LockModel:
 
     def held_at(self, fn_key: str, node: ast.AST) -> frozenset:
         return self.node_held.get(fn_key, {}).get(id(node), frozenset())
+
+    def lock_id(self, fn: FunctionNode, expr: ast.AST) -> Optional[tuple]:
+        """Public resolver: the lock identity an expression denotes inside
+        ``fn`` (``self._lock`` / module global / unique foreign attr)."""
+        return self._lock_id_resolver(fn)(expr)
 
     # -- call-graph attribution -------------------------------------------
 
@@ -398,7 +408,7 @@ class LockOrderRule(ProgramRule):
                     continue
                 for l2 in trans.get(site.callee, ()):
                     for h in held:
-                        if h == l2 and model.lock_factory(h) != "threading.Lock":
+                        if h == l2 and model.lock_factory(h) not in _NON_REENTRANT:
                             continue  # reentrant: re-acquiring is fine
                         add_edge(h, l2, fn.relpath, site.call)
 

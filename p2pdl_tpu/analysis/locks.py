@@ -43,6 +43,13 @@ _LOCK_FACTORIES = {
     "threading.Condition",
     "threading.Semaphore",
     "threading.BoundedSemaphore",
+    # The hybrid model: asyncio locks get the same program-unique
+    # identities, so lock-order cycles span the thread<->loop boundary
+    # and the asyncflow rules can tell the two worlds apart by factory.
+    "asyncio.Lock",
+    "asyncio.Condition",
+    "asyncio.Semaphore",
+    "asyncio.BoundedSemaphore",
 }
 _MUTATORS = {
     "append",

@@ -399,9 +399,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--only", default=None, metavar="RULE[,RULE]",
-        help="lint mode: run only the named rule(s); baseline entries for "
-        "other rules are ignored rather than reported stale. Unknown "
-        "names exit 2",
+        help="lint mode: run only the named rule(s); names may be fnmatch "
+        "globs (e.g. async-*) selecting a whole family. Baseline entries "
+        "for other rules are ignored rather than reported stale. Unknown "
+        "names or patterns matching nothing exit 2",
     )
     p.add_argument(
         "--changed", action="store_true",

@@ -1,0 +1,672 @@
+"""PR 20 async p2plint family: loop-context coloring, event-loop blocking
+sinks with the slow-lock refinement, the hybrid thread<->asyncio lock
+model, coroutine lifecycle, and loop-owned state discipline.
+
+Every rule gets a known-good / known-bad fixture pair; the good twins
+reconstruct the shapes `protocol/aio_transport.py` actually uses (short
+lock-guarded stats sections, `call_soon_threadsafe`-routed wakeups) so
+the tree staying clean is a tested property, not an accident. Pure
+tier-1: in-memory sources only, no jax.
+"""
+
+import textwrap
+
+import pytest
+
+from p2pdl_tpu.analysis.engine import (
+    ModuleInfo,
+    Program,
+    lint_program,
+    lint_source,
+    resolve_rules,
+)
+
+pytestmark = pytest.mark.lint
+
+
+def lint(src: str, relpath: str = "protocol/fake.py"):
+    return lint_source(textwrap.dedent(src), relpath)
+
+
+def lint_mods(*mods: tuple[str, str]):
+    return lint_program([ModuleInfo(textwrap.dedent(src), rel) for rel, src in mods])
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def model_of(*mods: tuple[str, str]):
+    from p2pdl_tpu.analysis.asyncflow import async_model_for
+
+    program = Program(
+        [ModuleInfo(textwrap.dedent(src), rel) for rel, src in mods]
+    )
+    return async_model_for(program)
+
+
+# ---- loop-context coloring --------------------------------------------------
+
+
+def test_async_defs_and_their_sync_callees_are_loop_colored():
+    m = model_of(
+        (
+            "protocol/a.py",
+            """
+            def helper():
+                pass
+
+            async def serve():
+                helper()
+
+            def thread_side():
+                helper()
+            """,
+        )
+    )
+    assert "protocol/a.py::serve" in m.loop_ctx
+    assert "protocol/a.py::helper" in m.loop_ctx
+    assert "protocol/a.py::thread_side" not in m.loop_ctx
+    # The witness chain names the async-def root.
+    assert m.loop_ctx["protocol/a.py::helper"][0] == "protocol/a.py::serve"
+
+
+def test_callbacks_handed_to_the_loop_are_colored_sync_roots():
+    m = model_of(
+        (
+            "protocol/a.py",
+            """
+            class T:
+                def send(self):
+                    self._loop.call_soon_threadsafe(self._wake, 1)
+
+                def later(self):
+                    self._loop.call_later(0.5, self._tick)
+
+                def _wake(self, dst):
+                    pass
+
+                def _tick(self):
+                    pass
+
+                def _never_registered(self):
+                    pass
+            """,
+        )
+    )
+    assert "protocol/a.py::T._wake" in m.loop_ctx
+    assert "protocol/a.py::T._tick" in m.loop_ctx  # call_later: arg index 1
+    assert "protocol/a.py::T._never_registered" not in m.loop_ctx
+    assert "protocol/a.py::T.send" not in m.loop_ctx  # registrar stays sync
+
+
+def test_blocking_sink_in_plain_thread_function_is_clean():
+    findings = lint(
+        """
+        import time
+
+        def spin():
+            time.sleep(0.01)
+        """
+    )
+    assert "async-blocking-call" not in rules_of(findings)
+
+
+# ---- async-blocking-call ----------------------------------------------------
+
+
+def test_time_sleep_reached_through_a_sync_helper_is_flagged_with_chain():
+    findings = lint(
+        """
+        import time
+
+        def helper():
+            time.sleep(0.5)
+
+        async def serve():
+            helper()
+        """
+    )
+    hits = [f for f in findings if f.rule == "async-blocking-call"]
+    assert len(hits) == 1
+    assert "time.sleep()" in hits[0].message
+    assert "`serve`" in hits[0].message and "`helper`" in hits[0].message
+
+
+@pytest.mark.parametrize(
+    "call",
+    [
+        "socket.create_connection(('h', 1))",
+        "subprocess.run(['ls'])",
+        "open('/tmp/x')",
+        "fut.result()",
+    ],
+)
+def test_synchronous_io_sinks_fire_in_async_context(call):
+    findings = lint(
+        f"""
+        import socket
+        import subprocess
+
+        async def serve(fut):
+            {call}
+        """
+    )
+    assert "async-blocking-call" in rules_of(findings)
+
+
+def test_queue_get_blocks_but_nowait_variants_do_not():
+    bad = lint(
+        """
+        import queue
+
+        class T:
+            def __init__(self):
+                self._q = queue.Queue()
+
+            async def pump(self):
+                return self._q.get()
+        """
+    )
+    good = lint(
+        """
+        import queue
+
+        class T:
+            def __init__(self):
+                self._q = queue.Queue()
+
+            async def pump(self):
+                a = self._q.get_nowait()
+                b = self._q.get(block=False)
+                return a, b
+        """
+    )
+    assert "async-blocking-call" in rules_of(bad)
+    assert "async-blocking-call" not in rules_of(good)
+
+
+def test_short_lock_section_on_the_loop_is_clean_the_aio_shape():
+    """The transport's own idiom: a threading lock guarding a few stats
+    writes, never held across a suspension — taking it on the loop is
+    sanctioned."""
+    findings = lint(
+        """
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._sent = 0
+
+            async def transmit(self):
+                with self._lock:
+                    self._sent += 1
+
+            def send(self):
+                with self._lock:
+                    self._sent += 1
+        """
+    )
+    assert "async-blocking-call" not in rules_of(findings)
+
+
+def test_slow_threading_lock_taken_on_the_loop_is_flagged():
+    """The same acquisition becomes a finding once the lock is held
+    across a blocking sink anywhere in the program."""
+    findings = lint(
+        """
+        import threading
+        import time
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._sent = 0
+
+            async def transmit(self):
+                with self._lock:
+                    self._sent += 1
+
+            def send(self):
+                with self._lock:
+                    time.sleep(1.0)
+        """
+    )
+    hits = [f for f in findings if f.rule == "async-blocking-call"]
+    assert len(hits) == 1
+    assert "T._lock" in hits[0].message and "time.sleep" in hits[0].message
+
+
+def test_lock_held_across_a_transitively_blocking_call_is_slow():
+    findings = lint(
+        """
+        import threading
+        import time
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _drain(self):
+                time.sleep(0.1)
+
+            def flush(self):
+                with self._lock:
+                    self._drain()
+
+            async def pump(self):
+                with self._lock:
+                    pass
+        """
+    )
+    hits = [f for f in findings if f.rule == "async-blocking-call"]
+    assert len(hits) == 1
+    assert "T._drain" in hits[0].message
+
+
+def test_condition_wait_does_not_mark_its_own_lock_slow():
+    findings = lint(
+        """
+        import threading
+
+        class T:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def recv(self):
+                with self._cv:
+                    self._cv.wait(timeout=0.2)
+
+            async def peek(self):
+                with self._cv:
+                    pass
+        """
+    )
+    assert "async-blocking-call" not in rules_of(findings)
+
+
+def test_inline_suppression_silences_a_sanctioned_blocking_site():
+    findings = lint(
+        """
+        import time
+
+        async def serve():
+            # p2plint: disable=async-blocking-call -- startup spin, loop not serving yet
+            time.sleep(0.01)
+        """
+    )
+    assert "async-blocking-call" not in rules_of(findings)
+
+
+# ---- async-lock-stall -------------------------------------------------------
+
+
+def test_await_while_holding_a_threading_lock_is_flagged():
+    findings = lint(
+        """
+        import asyncio
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def bad(self):
+                with self._lock:
+                    await asyncio.sleep(0)
+        """
+    )
+    hits = [f for f in findings if f.rule == "async-lock-stall"]
+    assert len(hits) == 1
+    assert "T._lock" in hits[0].message
+
+
+def test_await_under_an_asyncio_lock_is_clean():
+    findings = lint(
+        """
+        import asyncio
+
+        class T:
+            def __init__(self):
+                self._alock = asyncio.Lock()
+
+            async def good(self):
+                async with self._alock:
+                    await asyncio.sleep(0)
+        """
+    )
+    assert "async-lock-stall" not in rules_of(findings)
+
+
+def test_async_with_suspension_while_threading_lock_held_is_flagged():
+    findings = lint(
+        """
+        import asyncio
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._alock = asyncio.Lock()
+
+            async def bad(self):
+                with self._lock:
+                    async with self._alock:
+                        pass
+        """
+    )
+    assert "async-lock-stall" in rules_of(findings)
+
+
+# ---- hybrid lock-order ------------------------------------------------------
+
+
+def test_lock_order_cycle_across_the_thread_loop_boundary():
+    findings = lint(
+        """
+        import asyncio
+        import threading
+
+        class T:
+            def __init__(self):
+                self._tlock = threading.Lock()
+                self._alock = asyncio.Lock()
+
+            async def a(self):
+                with self._tlock:
+                    async with self._alock:
+                        pass
+
+            async def b(self):
+                async with self._alock:
+                    with self._tlock:
+                        pass
+        """
+    )
+    hits = [f for f in findings if f.rule == "lock-order"]
+    assert hits, "expected a cross-boundary lock-order cycle"
+    assert any("T._alock" in f.message and "T._tlock" in f.message for f in hits)
+
+
+def test_asyncio_lock_reacquisition_through_a_call_is_a_self_deadlock():
+    """asyncio.Lock is not reentrant: `async with` on a lock already held
+    by the same task deadlocks. The old rule only knew threading.Lock."""
+    findings = lint(
+        """
+        import asyncio
+
+        class T:
+            def __init__(self):
+                self._alock = asyncio.Lock()
+
+            async def inner(self):
+                async with self._alock:
+                    pass
+
+            async def outer(self):
+                async with self._alock:
+                    await self.inner()
+        """
+    )
+    hits = [f for f in findings if f.rule == "lock-order"]
+    assert any("T._alock" in f.message and "re-acquired" in f.message for f in hits)
+
+
+def test_threading_rlock_reacquisition_through_a_call_stays_clean():
+    findings = lint(
+        """
+        import threading
+
+        class T:
+            def __init__(self):
+                self._rlock = threading.RLock()
+
+            def inner(self):
+                with self._rlock:
+                    pass
+
+            def outer(self):
+                with self._rlock:
+                    self.inner()
+        """
+    )
+    assert "lock-order" not in rules_of(findings)
+
+
+# ---- async-coroutine-drop ---------------------------------------------------
+
+
+def test_unawaited_coroutine_call_is_flagged_and_awaited_is_clean():
+    bad = lint(
+        """
+        async def work():
+            pass
+
+        async def main():
+            work()
+        """
+    )
+    good = lint(
+        """
+        async def work():
+            pass
+
+        async def main():
+            await work()
+        """
+    )
+    hits = [f for f in bad if f.rule == "async-coroutine-drop"]
+    assert len(hits) == 1 and "work()" in hits[0].message
+    assert "async-coroutine-drop" not in rules_of(good)
+
+
+def test_dropped_create_task_result_is_flagged_and_retained_is_clean():
+    bad = lint(
+        """
+        import asyncio
+
+        async def work():
+            pass
+
+        async def main():
+            asyncio.create_task(work())
+        """
+    )
+    good = lint(
+        """
+        import asyncio
+
+        class T:
+            async def work(self):
+                pass
+
+            async def main(self):
+                self._task = asyncio.create_task(self.work())
+        """
+    )
+    assert "async-coroutine-drop" in rules_of(bad)
+    assert "async-coroutine-drop" not in rules_of(good)
+
+
+def test_run_coroutine_threadsafe_drop_is_flagged_even_unresolved():
+    findings = lint(
+        """
+        import asyncio
+
+        class T:
+            def stop(self):
+                asyncio.run_coroutine_threadsafe(self._shutdown(), self._loop)
+
+            async def _shutdown(self):
+                pass
+        """
+    )
+    assert "async-coroutine-drop" in rules_of(findings)
+
+
+# ---- async-loop-state -------------------------------------------------------
+
+
+def test_mixed_loop_and_thread_writes_without_a_lock_are_flagged():
+    findings = lint(
+        """
+        class T:
+            def __init__(self):
+                self._n = 0
+
+            async def on_loop(self):
+                self._n += 1
+
+            def on_thread(self):
+                self._n -= 1
+        """
+    )
+    hits = [f for f in findings if f.rule == "async-loop-state"]
+    assert len(hits) == 1
+    assert "T.on_loop" in hits[0].message and "T.on_thread" in hits[0].message
+
+
+def test_common_threading_lock_on_every_site_exonerates():
+    findings = lint(
+        """
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            async def on_loop(self):
+                with self._lock:
+                    self._n += 1
+
+            def on_thread(self):
+                with self._lock:
+                    self._n -= 1
+        """
+    )
+    assert "async-loop-state" not in rules_of(findings)
+
+
+def test_init_writes_and_single_world_writes_are_exempt():
+    findings = lint(
+        """
+        class T:
+            def __init__(self):
+                self._n = 0
+                self._loop_only = 0
+
+            async def on_loop(self):
+                self._loop_only += 1
+        """
+    )
+    assert "async-loop-state" not in rules_of(findings)
+
+
+def test_call_graph_lock_attribution_exonerates_a_helper_write():
+    findings = lint(
+        """
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def _bump(self):
+                self._n += 1
+
+            async def on_loop(self):
+                with self._lock:
+                    self._bump()
+
+            def on_thread(self):
+                with self._lock:
+                    self._n -= 1
+        """
+    )
+    assert "async-loop-state" not in rules_of(findings)
+
+
+# ---- cross-module coloring --------------------------------------------------
+
+
+def test_coloring_crosses_module_boundaries_through_imports():
+    findings = lint_mods(
+        (
+            "utils/helpers.py",
+            """
+            import time
+
+            def flush():
+                time.sleep(0.2)
+            """,
+        ),
+        (
+            "protocol/plane.py",
+            """
+            from p2pdl_tpu.utils.helpers import flush
+
+            async def serve():
+                flush()
+            """,
+        ),
+    )
+    hits = [f for f in findings if f.rule == "async-blocking-call"]
+    assert len(hits) == 1
+    assert hits[0].path == "utils/helpers.py"
+    assert "`serve`" in hits[0].message
+
+
+# ---- --only globs -----------------------------------------------------------
+
+
+def test_resolve_rules_expands_globs_to_the_family():
+    rules = resolve_rules("async-*")
+    assert {r.name for r in rules} == {
+        "async-blocking-call",
+        "async-coroutine-drop",
+        "async-lock-stall",
+        "async-loop-state",
+    }
+
+
+def test_resolve_rules_mixes_globs_and_names_without_duplicates():
+    rules = resolve_rules("lock-order,async-lock-*,lock-order")
+    assert [r.name for r in rules] == ["lock-order", "async-lock-stall"]
+
+
+def test_resolve_rules_rejects_a_glob_matching_nothing():
+    with pytest.raises(ValueError, match="no-such-"):
+        resolve_rules("no-such-*")
+
+
+# ---- registry completeness --------------------------------------------------
+
+
+def test_direct_asyncflow_import_does_not_shadow_the_other_families():
+    """Importing a rule module directly (as this very file does) must not
+    leave ``all_rules()`` with a partial registry: asyncflow pulls in the
+    lock modules, and a fresh interpreter whose first engine contact is
+    that import used to skip the remaining six families entirely.
+    Needs a subprocess — in this process the registry is already full."""
+    import subprocess
+    import sys
+
+    code = (
+        "import p2pdl_tpu.analysis.asyncflow\n"
+        "from p2pdl_tpu.analysis.engine import all_rules\n"
+        "names = {r.name for r in all_rules()}\n"
+        "missing = {'determinism-wallclock', 'wire-taint', 'hostsync-transfer',\n"
+        "           'telemetry-cardinality', 'async-blocking-call'} - names\n"
+        "assert not missing, f'partial rule registry, missing: {sorted(missing)}'\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        env={"PYTHONPATH": ""},
+        cwd=str(__import__("pathlib").Path(__file__).resolve().parent.parent),
+    )
+    assert proc.returncode == 0, proc.stderr

@@ -19,6 +19,8 @@ from p2pdl_tpu.analysis import run_lint
 from p2pdl_tpu.analysis.engine import DEFAULT_BASELINE_PATH, TODO_REASON, load_baseline
 from p2pdl_tpu.cli import main as cli_main
 
+pytestmark = pytest.mark.lint
+
 
 def test_tree_is_clean_modulo_baseline():
     result = run_lint()
@@ -200,6 +202,58 @@ BAD_FIXTURES = {
                         pass
         """,
     ),
+    # The async family (PR 20): each shape the aio transport plane must
+    # never regress into.
+    "async-blocking": (
+        "protocol/bad_async_blocking.py",
+        """
+        import time
+
+        async def serve():
+            time.sleep(0.5)
+        """,
+    ),
+    "async-lock-stall": (
+        "protocol/bad_async_stall.py",
+        """
+        import asyncio
+        import threading
+
+        class Plane:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def pump(self):
+                with self._lock:
+                    await asyncio.sleep(0)
+        """,
+    ),
+    "async-coroutine-drop": (
+        "protocol/bad_async_drop.py",
+        """
+        import asyncio
+
+        async def work():
+            pass
+
+        async def main():
+            asyncio.create_task(work())
+        """,
+    ),
+    "async-loop-state": (
+        "protocol/bad_async_state.py",
+        """
+        class Plane:
+            def __init__(self):
+                self._inflight = 0
+
+            async def on_loop(self):
+                self._inflight += 1
+
+            def on_thread(self):
+                self._inflight -= 1
+        """,
+    ),
 }
 
 
@@ -295,6 +349,36 @@ def test_cli_lint_flags_amplification_fixture_as_wiretaint(tmp_path, capsys):
     assert "unverified wire integer" in doc["new_findings"][0]["message"]
 
 
+@pytest.mark.parametrize(
+    "family,rule",
+    [
+        ("async-blocking", "async-blocking-call"),
+        ("async-lock-stall", "async-lock-stall"),
+        ("async-coroutine-drop", "async-coroutine-drop"),
+        ("async-loop-state", "async-loop-state"),
+    ],
+)
+def test_cli_lint_flags_async_fixture_with_its_family_rule(
+    tmp_path, capsys, family, rule
+):
+    """Acceptance: each async shape exits nonzero under its own rule (the
+    stall fixture also trips the blocking rule — a lock held across an
+    await is slow by definition)."""
+    _write_fixture(tmp_path, family)
+    rc = cli_main(
+        ["lint", "--json", "--lint-root", str(tmp_path), "--baseline",
+         str(tmp_path / "no-baseline.json")]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    hit_rules = {f["rule"] for f in doc["new_findings"]}
+    assert rule in hit_rules
+    assert hit_rules <= {
+        "async-blocking-call", "async-lock-stall",
+        "async-coroutine-drop", "async-loop-state",
+    }
+
+
 # ---- --only -----------------------------------------------------------------
 
 
@@ -315,6 +399,19 @@ def test_cli_lint_only_unknown_rule_is_a_usage_error(tmp_path, capsys):
     rc = cli_main(["lint", "--lint-root", str(tmp_path), "--only", "no-such-rule"])
     assert rc == 2
     assert "unknown rule" in capsys.readouterr().out
+
+
+def test_cli_lint_only_accepts_family_globs(tmp_path, capsys):
+    # A tree bad under two families: the glob selects just the async one.
+    _write_fixture(tmp_path, "determinism")
+    _write_fixture(tmp_path, "async-blocking")
+    base = ["lint", "--json", "--lint-root", str(tmp_path), "--baseline",
+            str(tmp_path / "no-baseline.json")]
+    assert cli_main(base + ["--only", "async-*"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in doc["new_findings"]} == {"async-blocking-call"}
+    # A glob matching nothing is a usage error, same as an unknown name.
+    assert cli_main(base + ["--only", "no-such-*"]) == 2
 
 
 def test_cli_lint_write_baseline_refuses_scoped_runs(tmp_path, capsys):
@@ -386,6 +483,51 @@ def test_cli_lint_changed_anchors_untracked_files_under_a_subdir_root(
 def test_cli_lint_changed_outside_a_repo_is_an_error(tmp_path, capsys):
     rc = cli_main(["lint", "--lint-root", str(tmp_path), "--changed"])
     assert rc == 2
+    assert "--changed needs a git checkout" in capsys.readouterr().out
+
+
+def test_cli_lint_changed_with_git_unavailable_is_a_usage_error(
+    tmp_path, capsys, monkeypatch
+):
+    """No git binary on PATH: exit 2 with a clear message, not a
+    traceback."""
+    empty = tmp_path / "empty-path"
+    empty.mkdir()
+    monkeypatch.setenv("PATH", str(empty))
+    rc = cli_main(["lint", "--lint-root", str(tmp_path), "--changed"])
+    assert rc == 2
+    assert "git unavailable for --changed" in capsys.readouterr().out
+
+
+def test_cli_lint_changed_leaves_unscanned_baseline_entries_untouched(
+    tmp_path, capsys
+):
+    """A --changed run scans a subset of files; baseline entries for paths
+    outside that subset must neither fail the run nor be reported stale —
+    and --write-baseline must refuse the combination outright (it would
+    silently drop every out-of-scope entry)."""
+    _write_fixture(tmp_path, "determinism")
+    baseline = str(tmp_path / "baseline.json")
+    base = ["lint", "--json", "--lint-root", str(tmp_path), "--baseline", baseline]
+    assert cli_main(base + ["--write-baseline"]) == 0
+    capsys.readouterr()
+    before = (tmp_path / "baseline.json").read_text()
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    # A fresh untracked bad file: --changed scans only it; the committed
+    # determinism entry is out of scope, not stale.
+    relpath = _write_fixture(tmp_path, "lock-order")
+    assert cli_main(base + ["--changed"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert {f["path"] for f in doc["new_findings"]} == {relpath}
+    assert doc["stale_baseline_entries"] == []
+    assert (tmp_path / "baseline.json").read_text() == before
+    # The refusal: exit 2, baseline file still byte-identical.
+    rc = cli_main(base + ["--changed", "--write-baseline"])
+    assert rc == 2
+    assert "--write-baseline cannot combine" in capsys.readouterr().out
+    assert (tmp_path / "baseline.json").read_text() == before
 
 
 # ---- --sarif ----------------------------------------------------------------
@@ -426,10 +568,16 @@ def test_cli_lint_json_reports_per_rule_seconds(capsys):
     assert cli_main(["lint", "--json"]) == 0
     doc = json.loads(capsys.readouterr().out)
     seconds = doc["rule_seconds"]
-    assert {"wire-taint", "lock-discipline", "lock-membership", "lock-order"} <= set(
-        seconds
-    )
+    # ProgramRules (callgraph/taint/async) are timed too, not just
+    # per-file rules...
+    assert {
+        "wire-taint", "lock-discipline", "lock-membership", "lock-order",
+        "async-blocking-call", "async-lock-stall",
+        "async-coroutine-drop", "async-loop-state",
+    } <= set(seconds)
     assert all(v >= 0 for v in seconds.values())
+    # ...and the keys come out sorted, for stable diffs across runs.
+    assert list(seconds) == sorted(seconds)
 
 
 # ---- baseline staleness pruning --------------------------------------------
